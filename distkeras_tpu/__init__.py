@@ -1,0 +1,72 @@
+"""distkeras_tpu — a TPU-native distributed training framework with the
+capabilities of dist-keras (SemanticBeeng/dist-keras).
+
+The reference trains Keras models data-parallel on Apache Spark through a
+socket parameter server; this framework keeps that user surface — Trainers
+(``SingleTrainer``, ``AveragingTrainer``, ``EnsembleTrainer``, ``DOWNPOUR``,
+``AEASGD``, ``EAMSGD``, ``ADAG``, ``DynSGD``), DataFrame transformers,
+predictors, evaluators — on an idiomatic JAX/XLA stack: workers are TPU mesh
+devices, the parameter-server center variable is replicated on-device, and
+commit/pull round-trips are XLA collectives over ICI/DCN inside a single
+compiled SPMD program.  See SURVEY.md for the reference analysis this build
+follows.
+"""
+
+__version__ = "0.1.0"
+
+from distkeras_tpu import frame, utils
+from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
+from distkeras_tpu.frame import DataFrame, Row, from_numpy, from_pandas, from_rows, read_csv
+from distkeras_tpu.predictors import ModelPredictor
+from distkeras_tpu.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    AsynchronousDistributedTrainer,
+    AveragingTrainer,
+    DistributedTrainer,
+    DynSGD,
+    EAMSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+    Trainer,
+)
+from distkeras_tpu.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+    StandardScaleTransformer,
+)
+
+__all__ = [
+    "DataFrame",
+    "Row",
+    "from_numpy",
+    "from_pandas",
+    "from_rows",
+    "read_csv",
+    "Trainer",
+    "SingleTrainer",
+    "AveragingTrainer",
+    "EnsembleTrainer",
+    "DistributedTrainer",
+    "AsynchronousDistributedTrainer",
+    "DOWNPOUR",
+    "AEASGD",
+    "EAMSGD",
+    "ADAG",
+    "DynSGD",
+    "ModelPredictor",
+    "AccuracyEvaluator",
+    "LossEvaluator",
+    "LabelIndexTransformer",
+    "OneHotTransformer",
+    "MinMaxTransformer",
+    "ReshapeTransformer",
+    "DenseTransformer",
+    "StandardScaleTransformer",
+    "frame",
+    "utils",
+]

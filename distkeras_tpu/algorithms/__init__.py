@@ -1,0 +1,23 @@
+"""Async-SGD update rules — the pure-function form of the reference's
+worker/parameter-server algorithm pairs (SURVEY.md §2, §3.3)."""
+
+from distkeras_tpu.algorithms.adag import Adag
+from distkeras_tpu.algorithms.aeasgd import Aeasgd, Eamsgd
+from distkeras_tpu.algorithms.base import CommitCtx, CommitResult, UpdateRule, make_ctx
+from distkeras_tpu.algorithms.downpour import Downpour
+from distkeras_tpu.algorithms.dynsgd import DynSGD
+from distkeras_tpu.algorithms.sequential import OneShotAverage, Sequential
+
+__all__ = [
+    "UpdateRule",
+    "CommitCtx",
+    "CommitResult",
+    "make_ctx",
+    "Downpour",
+    "Adag",
+    "Aeasgd",
+    "Eamsgd",
+    "DynSGD",
+    "Sequential",
+    "OneShotAverage",
+]

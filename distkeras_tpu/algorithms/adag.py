@@ -1,0 +1,43 @@
+"""ADAG — Accumulated-Gradient Normalization (Hermans, arXiv:1710.02368).
+
+Reference semantics (``distkeras/workers.py :: ADAGWorker.train``): like
+DOWNPOUR, but the accumulated residual is normalised by the number of local
+steps in the window before committing, which keeps the effective update
+magnitude independent of the communication window and (per the paper)
+stabilises convergence as worker count grows.
+
+TPU form: ``center += psum((local − anchor) / steps_in_window)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from distkeras_tpu.algorithms.base import CommitCtx, CommitResult, UpdateRule
+from distkeras_tpu.utils.pytree import tree_add, tree_sub, tree_where
+
+__all__ = ["Adag"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Adag(UpdateRule):
+    communication_window: int = 12
+
+    def init_local_state(self, params):
+        return {"anchor": params}
+
+    def commit(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
+        inv_w = 1.0 / ctx.steps_in_window
+        residual = jax.tree.map(
+            lambda x, a: (x - a) * inv_w, local_params, local_state["anchor"]
+        )
+        summed = ctx.psum(self._masked(ctx, residual))
+        new_center = tree_add(center_params, summed)
+        new_local = self._pull(ctx, new_center, local_params)
+        new_anchor = tree_where(ctx.mask, new_center, local_state["anchor"])
+        new_center_state = {
+            "num_updates": center_state["num_updates"] + self._count_commits(ctx)
+        }
+        return CommitResult(new_local, new_center, {"anchor": new_anchor}, new_center_state)

@@ -1,0 +1,64 @@
+"""AEASGD / EAMSGD — (Momentum) Asynchronous Elastic Averaging SGD
+(Zhang, Choromanska & LeCun, NIPS 2015).
+
+Reference semantics (``distkeras/workers.py :: AEASGDWorker.train``, §3.3 of
+SURVEY.md): every ``communication_window`` (τ) steps the worker computes the
+elastic difference ``E = α·(x − center)`` with ``α = learning_rate·ρ``,
+subtracts it from its local variable, and commits it; the PS does
+``center += E``.  Workers never pull — the elastic force is the only coupling,
+which is what lets local variables *explore* around the center.
+
+EAMSGD is identical at the commit boundary; the momentum lives in the local
+optimizer (the engine uses Nesterov-momentum SGD as the worker optimizer, the
+TPU-native form of the reference's explicit velocity update).
+
+TPU form: ``E_i = α(x_i − center)``; ``x_i −= E_i``; ``center += psum(E_i)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from distkeras_tpu.algorithms.base import CommitCtx, CommitResult, UpdateRule
+from distkeras_tpu.utils.pytree import tree_add, tree_sub
+
+__all__ = ["Aeasgd", "Eamsgd"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Aeasgd(UpdateRule):
+    communication_window: int = 32
+    rho: float = 5.0
+    learning_rate: float = 0.1
+    pulls: bool = False
+
+    @property
+    def alpha(self) -> float:
+        return self.learning_rate * self.rho
+
+    def init_local_state(self, params):
+        return ()
+
+    def commit(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
+        alpha = self.alpha
+        elastic = jax.tree.map(lambda x, c: alpha * (x - c), local_params, center_params)
+        elastic = self._masked(ctx, elastic)
+        new_local = tree_sub(local_params, elastic)
+        new_center = tree_add(center_params, ctx.psum(elastic))
+        new_center_state = {
+            "num_updates": center_state["num_updates"] + self._count_commits(ctx)
+        }
+        return CommitResult(new_local, new_center, local_state, new_center_state)
+
+
+@dataclasses.dataclass(frozen=True)
+class Eamsgd(Aeasgd):
+    """EAMSGD: elastic averaging + Nesterov momentum on the local variable.
+
+    The commit rule is AEASGD's; trainers pair it with a momentum worker
+    optimizer (reference parity: ``EAMSGDWorker``'s explicit velocity).
+    """
+
+    momentum: float = 0.9

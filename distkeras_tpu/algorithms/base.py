@@ -1,0 +1,107 @@
+"""Update-rule interface: the pure-function form of the reference's
+parameter-server protocols.
+
+In the reference, each algorithm is split across a Worker (client: accumulate
+a residual, ``commit``/``pull`` over TCP — ``distkeras/workers.py``) and a
+ParameterServer (server: apply the committed delta to the center variable —
+``distkeras/parameter_servers.py :: handle_commit``).  On TPU both halves fuse
+into one pure ``commit`` function executed *inside* the SPMD program at a
+window boundary: the worker-side delta computation runs per-device, the
+server-side "apply to center" is an ``psum`` over the worker mesh axis
+followed by a replicated center update.  The TCP round-trip disappears; its
+semantics remain.
+
+Every rule is a pure pytree transform, unit-testable against the closed-form
+math in SURVEY.md §3.3 without any mesh at all (pass ``psum=identity``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.utils.pytree import tree_sub, tree_where, tree_zeros_like
+
+__all__ = ["CommitCtx", "CommitResult", "UpdateRule", "make_ctx"]
+
+
+class CommitCtx(NamedTuple):
+    """Execution context handed to ``commit`` at a window boundary.
+
+    ``psum``  — sum over the worker axis (identity when testing single-worker).
+    ``mask``  — scalar bool: does *this* worker commit at this boundary?  In
+                the synchronous-window engine it is constant True; in the
+                staleness-simulation engine it encodes each worker's own
+                commit schedule, which is what models real-world asynchrony
+                deterministically.
+    ``steps_in_window`` — local optimizer steps since this worker's last
+                commit (ADAG normalises by it).
+    """
+
+    psum: Callable[[Any], Any]
+    mask: jnp.ndarray
+    steps_in_window: jnp.ndarray
+    num_workers: int
+
+
+class CommitResult(NamedTuple):
+    local_params: Any
+    center_params: Any
+    local_state: Any
+    center_state: Any
+
+
+def make_ctx(axis_name=None, mask=True, steps_in_window=1, num_workers=1) -> CommitCtx:
+    psum = (lambda t: jax.tree.map(lambda x: lax.psum(x, axis_name), t)) if axis_name else (lambda t: t)
+    return CommitCtx(
+        psum=psum,
+        mask=jnp.asarray(mask),
+        steps_in_window=jnp.asarray(steps_in_window, jnp.float32),
+        num_workers=num_workers,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdateRule:
+    """Base class: one async-SGD variant = one subclass.
+
+    ``communication_window`` mirrors the reference trainers' kwarg of the same
+    name: number of local steps between commits.
+    """
+
+    communication_window: int = 5
+
+    #: do committing workers re-pull (adopt) the center after commit?
+    pulls: bool = True
+
+    def init_local_state(self, params) -> Any:
+        """Per-worker rule state (anchors, clocks); params = initial center."""
+        return ()
+
+    def init_center_state(self) -> Any:
+        """Replicated center-side state (update counters)."""
+        return {"num_updates": jnp.zeros((), jnp.int32)}
+
+    def commit(
+        self, ctx: CommitCtx, local_params, center_params, local_state, center_state
+    ) -> CommitResult:
+        raise NotImplementedError
+
+    # -- shared helpers ----------------------------------------------------
+    @staticmethod
+    def _masked(ctx: CommitCtx, tree):
+        m = ctx.mask.astype(jnp.float32)
+        return jax.tree.map(lambda x: x * m, tree)
+
+    @staticmethod
+    def _count_commits(ctx: CommitCtx):
+        return ctx.psum(ctx.mask.astype(jnp.int32))
+
+    @staticmethod
+    def _pull(ctx: CommitCtx, new_center, local_params):
+        """Committing workers adopt the fresh center (the reference's ``pull``)."""
+        return tree_where(ctx.mask, new_center, local_params)

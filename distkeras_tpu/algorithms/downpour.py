@@ -1,0 +1,39 @@
+"""DOWNPOUR (Dean et al., NIPS 2012) as a windowed-delta collective rule.
+
+Reference semantics (``distkeras/workers.py :: DOWNPOURWorker.train`` +
+``parameter_servers.py :: DeltaParameterServer.handle_commit``): each worker
+accumulates the weight residual over ``communication_window`` local steps,
+commits it (PS does ``center += delta``), then pulls the fresh center.
+
+TPU form: the residual is ``local − anchor`` where ``anchor`` is the center
+value at this worker's last pull; the PS apply becomes
+``center += psum(residual)``; the pull is a masked adopt of the new center.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from distkeras_tpu.algorithms.base import CommitCtx, CommitResult, UpdateRule
+from distkeras_tpu.utils.pytree import tree_add, tree_sub, tree_where
+
+__all__ = ["Downpour"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Downpour(UpdateRule):
+    communication_window: int = 5
+
+    def init_local_state(self, params):
+        return {"anchor": params}
+
+    def commit(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
+        residual = tree_sub(local_params, local_state["anchor"])
+        summed = ctx.psum(self._masked(ctx, residual))
+        new_center = tree_add(center_params, summed)
+        new_local = self._pull(ctx, new_center, local_params)
+        new_anchor = tree_where(ctx.mask, new_center, local_state["anchor"])
+        new_center_state = {
+            "num_updates": center_state["num_updates"] + self._count_commits(ctx)
+        }
+        return CommitResult(new_local, new_center, {"anchor": new_anchor}, new_center_state)

@@ -1,0 +1,55 @@
+"""DynSGD — staleness-aware dynamic-learning-rate SGD (Jiang et al.,
+SIGMOD 2017, as implemented by the reference).
+
+Reference semantics (``distkeras/workers.py :: DynSGDWorker`` +
+``parameter_servers.py :: DynSGDParameterServer.handle_commit``): each commit
+carries the worker's update clock; the PS computes
+``staleness = num_updates − worker_clock`` and applies
+``center += delta / (staleness + 1)`` so stale contributions are damped.
+
+TPU form: staleness is *modeled deterministically* — per-worker clocks are
+carried in rule state, ``num_updates`` is the replicated commit counter, and
+staleness is computed against the counter value *before* the current commit
+batch.  Under uniform synchronous windows every staleness is 0 (DynSGD ≡
+DOWNPOUR — the correct degenerate case); with per-worker commit schedules
+(the staleness-simulation engine) slow-committing workers see positive
+staleness exactly as they would racing a real parameter server, but
+bit-for-bit reproducibly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.algorithms.base import CommitCtx, CommitResult, UpdateRule
+from distkeras_tpu.utils.pytree import tree_add, tree_where
+
+__all__ = ["DynSGD"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DynSGD(UpdateRule):
+    communication_window: int = 5
+
+    def init_local_state(self, params):
+        return {"anchor": params, "clock": jnp.zeros((), jnp.int32)}
+
+    def commit(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
+        num_updates = center_state["num_updates"]
+        staleness = (num_updates - local_state["clock"]).astype(jnp.float32)
+        scale = 1.0 / (staleness + 1.0)
+        delta = jax.tree.map(
+            lambda x, a: (x - a) * scale, local_params, local_state["anchor"]
+        )
+        summed = ctx.psum(self._masked(ctx, delta))
+        new_center = tree_add(center_params, summed)
+        new_num_updates = num_updates + self._count_commits(ctx)
+        new_local = self._pull(ctx, new_center, local_params)
+        new_state = {
+            "anchor": tree_where(ctx.mask, new_center, local_state["anchor"]),
+            "clock": jnp.where(ctx.mask, new_num_updates, local_state["clock"]),
+        }
+        return CommitResult(new_local, new_center, new_state, {"num_updates": new_num_updates})

@@ -1,0 +1,45 @@
+"""Sequential (no-op) and one-shot-averaging rules.
+
+``Sequential`` backs ``SingleTrainer`` (reference:
+``distkeras/workers.py :: SequentialWorker`` — plain local SGD, no PS).
+``OneShotAverage`` backs ``AveragingTrainer`` (reference:
+``trainers.py :: AveragingTrainer.average_models`` — train independent
+replicas, average the weights once at the end); on TPU the average is a
+single ``pmean`` over the worker axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from distkeras_tpu.algorithms.base import CommitCtx, CommitResult, UpdateRule
+
+__all__ = ["Sequential", "OneShotAverage"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Sequential(UpdateRule):
+    """No commits: pure local training (the reference's SequentialWorker)."""
+
+    communication_window: int = 0  # 0 => never commit mid-training
+    pulls: bool = False
+
+    def commit(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
+        return CommitResult(local_params, local_params, local_state, center_state)
+
+
+@dataclasses.dataclass(frozen=True)
+class OneShotAverage(UpdateRule):
+    """Single synchronous weight average at end of training."""
+
+    communication_window: int = 0
+    pulls: bool = True
+
+    def commit(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
+        mean = jax.tree.map(lambda x: x / ctx.num_workers, ctx.psum(local_params))
+        new_center_state = {
+            "num_updates": center_state["num_updates"] + self._count_commits(ctx)
+        }
+        return CommitResult(mean, mean, local_state, new_center_state)

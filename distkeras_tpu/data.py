@@ -1,0 +1,64 @@
+"""Host-side batching: DataFrame columns -> mesh-shaped epoch arrays.
+
+The reference streams partition row-iterators into per-worker minibatch loops
+(``distkeras/workers.py`` minibatch iterator).  The TPU engine instead wants
+the whole epoch as one statically-shaped array
+``[num_workers, n_windows, window, batch, ...]`` so a single jitted
+``shard_map`` program can scan it.  This module builds those arrays with
+wrap-around padding (no sample dropped, matching the reference's
+use-every-row behaviour) and per-epoch host-side shuffling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["epoch_arrays", "plan_epoch"]
+
+
+def plan_epoch(n: int, num_workers: int, batch_size: int, window: int) -> Tuple[int, int]:
+    """(n_windows, padded_total): smallest window grid covering all n samples."""
+    window = max(1, window)
+    per_step = num_workers * batch_size
+    steps = max(1, -(-n // per_step))  # ceil
+    n_windows = max(1, -(-steps // window))
+    return n_windows, n_windows * window * per_step
+
+
+def epoch_arrays(
+    features: np.ndarray,
+    labels: np.ndarray,
+    num_workers: int,
+    batch_size: int,
+    window: int,
+    *,
+    stepwise: bool = False,
+    rng: Optional[np.random.Generator] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Shuffle + wrap-pad + reshape one epoch of data.
+
+    Uniform mode: leaves shaped ``[num_workers, n_windows, window, batch, ...]``.
+    Stepwise (staleness-sim) mode: ``[num_workers, n_steps, batch, ...]``.
+    """
+    n = len(features)
+    if n == 0:
+        raise ValueError("empty dataset")
+    idx = np.arange(n)
+    if rng is not None:
+        rng.shuffle(idx)
+    n_windows, total = plan_epoch(n, num_workers, batch_size, window)
+    reps = -(-total // n)
+    idx = np.tile(idx, reps)[:total]
+    # Interleave so each worker sees a contiguous stream (like a partition)
+    # but batches are drawn round-robin across the shuffled index.
+    xs = features[idx]
+    ys = labels[idx]
+    if stepwise:
+        shape = (num_workers, n_windows * window, batch_size)
+    else:
+        shape = (num_workers, n_windows, window, batch_size)
+    xs = xs.reshape(shape + features.shape[1:])
+    ys = ys.reshape(shape + labels.shape[1:])
+    return xs, ys

@@ -1,0 +1,68 @@
+"""Evaluators — reference parity for ``distkeras/evaluators.py``.
+
+``AccuracyEvaluator.evaluate(df)`` compares a prediction column against a
+label column and returns scalar accuracy; the reference does this as a Spark
+row filter + count, here it is one vectorised numpy comparison.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.frame import DataFrame
+
+__all__ = ["Evaluator", "AccuracyEvaluator", "LossEvaluator"]
+
+
+class Evaluator:
+    def evaluate(self, dataframe: DataFrame) -> float:
+        raise NotImplementedError
+
+
+class AccuracyEvaluator(Evaluator):
+    """Fraction of rows where prediction matches label (reference parity:
+    ``AccuracyEvaluator(prediction_col, label_col)``).
+
+    Either column may hold class indices or probability / one-hot vectors;
+    vectors are argmaxed first (the reference requires a prior
+    ``LabelIndexTransformer`` pass — we accept both forms).
+    """
+
+    def __init__(self, prediction_col: str = "prediction", label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    @staticmethod
+    def _to_index(col: np.ndarray) -> np.ndarray:
+        if col.dtype == object:
+            col = np.stack([np.asarray(v) for v in col])
+        col = np.asarray(col)
+        if col.ndim > 1 and col.shape[-1] > 1:
+            return np.argmax(col.reshape(len(col), -1), axis=-1)
+        return col.reshape(-1).astype(np.int64)
+
+    def evaluate(self, dataframe: DataFrame) -> float:
+        preds = self._to_index(dataframe.column(self.prediction_col))
+        labels = self._to_index(dataframe.column(self.label_col))
+        if len(preds) == 0:
+            return 0.0
+        return float(np.mean(preds == labels))
+
+
+class LossEvaluator(Evaluator):
+    """Mean loss over a DataFrame (extension beyond the reference set)."""
+
+    def __init__(self, loss="categorical_crossentropy", prediction_col: str = "prediction",
+                 label_col: str = "label", from_logits: bool = False):
+        from distkeras_tpu.ops import get_loss
+
+        self.loss_fn = get_loss(loss, from_logits=from_logits)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataframe: DataFrame) -> float:
+        import jax.numpy as jnp
+
+        preds = jnp.asarray(dataframe.matrix(self.prediction_col))
+        labels = jnp.asarray(dataframe.matrix(self.label_col))
+        return float(self.loss_fn(preds, labels))

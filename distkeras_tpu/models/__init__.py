@@ -1,0 +1,23 @@
+"""Model layer: functional adapters (Keras-3 / flax) and the in-tree zoo."""
+
+from distkeras_tpu.models.adapter import (
+    FlaxModel,
+    FunctionalModel,
+    ModelAdapter,
+    TrainedModel,
+    as_adapter,
+)
+from distkeras_tpu.models.zoo import CIFARCNN, MLP, MNISTCNN, ResNet20, TextCNN
+
+__all__ = [
+    "ModelAdapter",
+    "FlaxModel",
+    "FunctionalModel",
+    "TrainedModel",
+    "as_adapter",
+    "MLP",
+    "MNISTCNN",
+    "CIFARCNN",
+    "ResNet20",
+    "TextCNN",
+]

@@ -1,0 +1,143 @@
+"""Model adapters: a single functional interface over Keras-3 and Flax models.
+
+The reference ships Keras models into Spark executors as JSON+weights blobs
+and calls ``model.train_on_batch`` inside the worker loop
+(``distkeras/workers.py :: Worker.prepare_model / train``).  On TPU the model
+must instead be a *pure function* ``(params, state, inputs) -> outputs`` so it
+can be jitted, differentiated, and sharded.  ``ModelAdapter`` is that
+interface; :class:`FlaxModel` wraps ``flax.linen`` modules from the in-tree
+zoo and :mod:`distkeras_tpu.models.keras_adapter` wraps user Keras-3 models
+(the reference's input type) via ``stateless_call``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ModelAdapter", "FlaxModel", "TrainedModel", "as_adapter"]
+
+
+class ModelAdapter:
+    """Functional model interface.
+
+    ``params``  — trainable parameter pytree (what the optimizer updates and
+                  what the parameter-server center variable holds).
+    ``state``   — non-trainable pytree (BatchNorm statistics etc.); may be an
+                  empty dict.
+    """
+
+    #: whether ``apply`` outputs are logits (True for the in-tree zoo) or
+    #: post-activation probabilities (Keras models with softmax heads).
+    outputs_logits: bool = True
+
+    def init(self, rng: jax.Array, sample_input: np.ndarray) -> Tuple[Any, Any]:
+        raise NotImplementedError
+
+    def apply(
+        self,
+        params: Any,
+        state: Any,
+        inputs: jnp.ndarray,
+        training: bool = False,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[jnp.ndarray, Any]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class FlaxModel(ModelAdapter):
+    """Adapter over a ``flax.linen.Module`` (used by the in-tree model zoo)."""
+
+    module: Any
+    outputs_logits: bool = True
+
+    def init(self, rng, sample_input):
+        variables = self.module.init(rng, jnp.asarray(sample_input), training=False)
+        params = variables.get("params", {})
+        state = {k: v for k, v in variables.items() if k != "params"}
+        return params, state
+
+    def apply(self, params, state, inputs, training=False, rng=None):
+        variables = {"params": params, **state}
+        rngs = {"dropout": rng} if rng is not None else {}
+        if training and state:
+            out, updates = self.module.apply(
+                variables, inputs, training=True, rngs=rngs, mutable=list(state.keys())
+            )
+            return out, dict(updates)
+        out = self.module.apply(variables, inputs, training=training, rngs=rngs)
+        return out, state
+
+
+@dataclasses.dataclass
+class FunctionalModel(ModelAdapter):
+    """Adapter over plain ``(init_fn, apply_fn)`` pairs (haiku-style)."""
+
+    init_fn: Callable
+    apply_fn: Callable
+    outputs_logits: bool = True
+
+    def init(self, rng, sample_input):
+        params = self.init_fn(rng, jnp.asarray(sample_input))
+        return params, {}
+
+    def apply(self, params, state, inputs, training=False, rng=None):
+        return self.apply_fn(params, inputs), state
+
+
+class TrainedModel:
+    """What trainers return on the pure-JAX path: params + a predict method.
+
+    (On the Keras path trainers return the original Keras model with trained
+    weights assigned, matching the reference's ``Trainer.train`` contract.)
+    """
+
+    def __init__(self, adapter: ModelAdapter, params, state, history=None):
+        self.adapter = adapter
+        self.params = params
+        self.state = state
+        self.history = history or {}
+        self._jit_apply = jax.jit(
+            lambda p, s, x: adapter.apply(p, s, x, training=False)[0]
+        )
+
+    def predict(self, inputs, batch_size: int = 1024) -> np.ndarray:
+        inputs = np.asarray(inputs)
+        outs = []
+        for i in range(0, len(inputs), batch_size):
+            outs.append(np.asarray(self._jit_apply(self.params, self.state, inputs[i : i + batch_size])))
+        out = np.concatenate(outs) if outs else np.empty((0,))
+        if self.adapter.outputs_logits:
+            out = np.asarray(jax.nn.softmax(out, axis=-1)) if out.ndim > 1 and out.shape[-1] > 1 else out
+        return out
+
+    def __call__(self, inputs):
+        return self._jit_apply(self.params, self.state, jnp.asarray(inputs))
+
+
+def as_adapter(model) -> ModelAdapter:
+    """Coerce user input (Keras model / flax module / adapter) to an adapter."""
+    if isinstance(model, ModelAdapter):
+        return model
+    # flax linen module?
+    try:
+        import flax.linen as nn
+
+        if isinstance(model, nn.Module):
+            return FlaxModel(model)
+    except ImportError:  # pragma: no cover
+        pass
+    # Keras model? (lazy import: keras is heavy)
+    if type(model).__module__.split(".")[0] in ("keras", "tf_keras", "tensorflow"):
+        from distkeras_tpu.models.keras_adapter import KerasModel
+
+        return KerasModel(model)
+    raise TypeError(
+        f"cannot adapt {type(model)!r}: pass a Keras 3 model, flax.linen.Module, "
+        "or distkeras_tpu ModelAdapter"
+    )

@@ -1,0 +1,66 @@
+"""Keras-3 (JAX backend) adapter — reference-API parity for user models.
+
+The reference's whole API takes compiled Keras models
+(``distkeras/trainers.py :: Trainer.__init__(keras_model, ...)``).  Keras 3
+runs natively on JAX and exposes ``model.stateless_call`` — a pure function
+over explicit trainable/non-trainable variable lists — which is exactly the
+:class:`~distkeras_tpu.models.adapter.ModelAdapter` contract, so Keras models
+train under ``jit``/``shard_map`` on TPU with zero translation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Tuple
+
+import numpy as np
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+from distkeras_tpu.models.adapter import ModelAdapter
+
+__all__ = ["KerasModel", "assign_keras_weights"]
+
+
+class KerasModel(ModelAdapter):
+    """Wrap a Keras 3 model as a pure functional adapter via ``stateless_call``."""
+
+    # Keras models conventionally end in softmax/sigmoid activations.
+    outputs_logits = False
+
+    def __init__(self, model):
+        import keras
+
+        if keras.backend.backend() != "jax":
+            raise RuntimeError(
+                "distkeras_tpu requires the Keras JAX backend; set KERAS_BACKEND=jax "
+                "before importing keras"
+            )
+        self.model = model
+
+    def init(self, rng, sample_input) -> Tuple[Any, Any]:
+        if not self.model.built:
+            self.model.build(np.asarray(sample_input).shape)
+        params = [v.value for v in self.model.trainable_variables]
+        state = {"ntv": [v.value for v in self.model.non_trainable_variables]}
+        return params, state
+
+    def apply(self, params, state, inputs, training=False, rng=None):
+        outputs, ntv = self.model.stateless_call(
+            params, state["ntv"], inputs, training=training
+        )
+        return outputs, {"ntv": ntv}
+
+    def assign(self, params, state=None):
+        """Write trained values back onto the Keras model (what ``train`` returns)."""
+        assign_keras_weights(self.model, params, (state or {}).get("ntv"))
+        return self.model
+
+
+def assign_keras_weights(model, trainable_values, non_trainable_values=None):
+    for var, val in zip(model.trainable_variables, trainable_values):
+        var.assign(np.asarray(val))
+    if non_trainable_values is not None:
+        for var, val in zip(model.non_trainable_variables, non_trainable_values):
+            var.assign(np.asarray(val))
+    return model

@@ -1,0 +1,136 @@
+"""In-tree model zoo (flax.linen) covering the reference's benchmark models.
+
+The reference defines its models ad-hoc in ``examples/mnist.py`` /
+``examples/mnist.ipynb`` (Keras Sequential MLP and CNN) and the README
+experiments (CIFAR-10 CNN / ResNet-20, IMDB text-CNN per ``BASELINE.json``).
+Here they are first-class flax modules, written TPU-first: channel counts
+padded to MXU-friendly multiples, logits outputs (loss fuses the softmax),
+NHWC conv layouts, and no data-dependent Python control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["MLP", "MNISTCNN", "CIFARCNN", "ResNet20", "TextCNN"]
+
+
+class MLP(nn.Module):
+    """The reference MNIST MLP (examples/mnist.py: Dense stack + softmax head),
+    emitted as logits."""
+
+    features: Sequence[int] = (500, 250, 125)
+    num_classes: int = 10
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = nn.relu(nn.Dense(f, dtype=jnp.float32)(x))
+            if self.dropout > 0:
+                x = nn.Dropout(self.dropout, deterministic=not training)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class MNISTCNN(nn.Module):
+    """Small convnet for 28x28x1 inputs (reference: examples/mnist.py CNN)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if x.ndim == 2:  # flat 784 vectors from the DataFrame path
+            x = x.reshape((x.shape[0], 28, 28, 1))
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class CIFARCNN(nn.Module):
+    """CIFAR-10 CNN — the headline benchmark model (BASELINE.json config 3)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 32, 32, 3))
+        for filters in (64, 128):
+            x = nn.relu(nn.Conv(filters, (3, 3))(x))
+            x = nn.relu(nn.Conv(filters, (3, 3))(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(256)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class _ResBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        norm = lambda: nn.BatchNorm(use_running_average=not training, momentum=0.9)
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides), use_bias=False)(x)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.filters, (3, 3), use_bias=False)(y)
+        y = norm()(y)
+        if x.shape[-1] != self.filters or self.strides != 1:
+            x = nn.Conv(self.filters, (1, 1), strides=(self.strides, self.strides), use_bias=False)(x)
+        return nn.relu(x + y)
+
+
+class ResNet20(nn.Module):
+    """ResNet-20 (He et al.) for CIFAR-10 — BASELINE.json config 4 (ADAG).
+
+    Carries BatchNorm running statistics as non-trainable model state, the
+    hard case the reference never had to solve (Keras hid it); the engine
+    synchronises these across workers at commit boundaries.
+    """
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 32, 32, 3))
+        x = nn.Conv(16, (3, 3), use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not training, momentum=0.9)(x))
+        for filters, strides in ((16, 1), (16, 1), (16, 1), (32, 2), (32, 1), (32, 1), (64, 2), (64, 1), (64, 1)):
+            x = _ResBlock(filters, strides)(x, training=training)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class TextCNN(nn.Module):
+    """IMDB text-CNN (Kim 2014 style) — BASELINE.json config 5 (DynSGD).
+
+    Input: int32 token ids [batch, seq_len].
+    """
+
+    vocab_size: int = 20000
+    embed_dim: int = 128
+    kernel_sizes: Sequence[int] = (3, 4, 5)
+    filters: int = 128
+    num_classes: int = 2
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = nn.Embed(self.vocab_size, self.embed_dim)(x.astype(jnp.int32))
+        pooled = []
+        for k in self.kernel_sizes:
+            h = nn.relu(nn.Conv(self.filters, (k,))(x))  # [b, seq, filters]
+            pooled.append(jnp.max(h, axis=1))
+        x = jnp.concatenate(pooled, axis=-1)
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, deterministic=not training)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,7 @@
+"""Compute ops: losses, metrics, optimizer registry (all jit-safe)."""
+
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.metrics import accuracy, get_metric
+from distkeras_tpu.ops.optimizers import get_optimizer
+
+__all__ = ["get_loss", "get_metric", "get_optimizer", "accuracy"]

@@ -1,0 +1,76 @@
+"""Loss registry with Keras-string parity.
+
+The reference passes Keras loss strings straight into ``model.compile``
+(``distkeras/workers.py :: Worker.prepare_model``).  Here the same strings
+resolve to pure jit-safe functions ``loss(preds, labels) -> scalar``; each has
+a logits and a probabilities form so both the in-tree zoo (logits out) and
+Keras models (softmax out) get numerically-stable loss values.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["get_loss"]
+
+_EPS = 1e-7
+
+
+def _maybe_onehot(labels, num_classes):
+    labels = jnp.asarray(labels)
+    if labels.ndim >= 1 and labels.shape[-1] == num_classes and jnp.issubdtype(labels.dtype, jnp.floating):
+        return labels
+    return jax.nn.one_hot(labels.reshape(labels.shape[0], -1)[..., 0].astype(jnp.int32), num_classes)
+
+
+def _categorical_crossentropy(from_logits: bool):
+    def loss(preds, labels):
+        labels = _maybe_onehot(labels, preds.shape[-1])
+        if from_logits:
+            return optax.softmax_cross_entropy(preds, labels).mean()
+        p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+        return -(labels * jnp.log(p)).sum(-1).mean()
+
+    return loss
+
+
+def _binary_crossentropy(from_logits: bool):
+    def loss(preds, labels):
+        preds = preds.reshape(preds.shape[0], -1)
+        labels = jnp.asarray(labels, preds.dtype).reshape(preds.shape)
+        if from_logits:
+            return optax.sigmoid_binary_cross_entropy(preds, labels).mean()
+        p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+        return -(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p)).mean()
+
+    return loss
+
+
+def _mse(preds, labels):
+    labels = jnp.asarray(labels, preds.dtype).reshape(preds.shape)
+    return jnp.mean(jnp.square(preds - labels))
+
+
+def _mae(preds, labels):
+    labels = jnp.asarray(labels, preds.dtype).reshape(preds.shape)
+    return jnp.mean(jnp.abs(preds - labels))
+
+
+def get_loss(spec, from_logits: bool = True) -> Callable:
+    """Resolve a Keras-style loss string (or pass through a callable)."""
+    if callable(spec):
+        return spec
+    name = str(spec).lower()
+    if name in ("categorical_crossentropy", "sparse_categorical_crossentropy", "crossentropy"):
+        return _categorical_crossentropy(from_logits)
+    if name in ("binary_crossentropy",):
+        return _binary_crossentropy(from_logits)
+    if name in ("mse", "mean_squared_error"):
+        return _mse
+    if name in ("mae", "mean_absolute_error"):
+        return _mae
+    raise ValueError(f"unknown loss {spec!r}")

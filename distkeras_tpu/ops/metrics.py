@@ -1,0 +1,32 @@
+"""Jit-safe metric functions (mirrors the ``metrics=['accuracy']`` surface of
+``distkeras/trainers.py`` and the offline ``AccuracyEvaluator``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["accuracy", "get_metric"]
+
+
+def accuracy(preds, labels):
+    """Top-1 accuracy; labels may be class indices or one-hot/prob vectors."""
+    preds = jnp.asarray(preds)
+    labels = jnp.asarray(labels)
+    if preds.ndim > 1 and preds.shape[-1] > 1:
+        pred_idx = jnp.argmax(preds, axis=-1)
+    else:
+        pred_idx = (preds.reshape(-1) > 0.5).astype(jnp.int32)
+    if labels.ndim > 1 and labels.shape[-1] > 1:
+        label_idx = jnp.argmax(labels, axis=-1)
+    else:
+        label_idx = labels.reshape(-1).astype(jnp.int32)
+    return jnp.mean((pred_idx == label_idx).astype(jnp.float32))
+
+
+def get_metric(spec):
+    if callable(spec):
+        return spec
+    name = str(spec).lower()
+    if name in ("accuracy", "acc", "categorical_accuracy"):
+        return accuracy
+    raise ValueError(f"unknown metric {spec!r}")

@@ -1,0 +1,46 @@
+"""Worker-optimizer registry.
+
+The reference hands Keras optimizer strings/objects to ``model.compile`` in
+the worker (``distkeras/workers.py``).  Here the same strings resolve to
+``optax`` gradient transformations — the local (worker-side) optimizer that
+runs between parameter-server commits.
+"""
+
+from __future__ import annotations
+
+import optax
+
+__all__ = ["get_optimizer"]
+
+_DEFAULT_LR = {
+    "sgd": 0.01,
+    "momentum": 0.01,
+    "adam": 0.001,
+    "adagrad": 0.01,
+    "rmsprop": 0.001,
+    "adamw": 0.001,
+}
+
+
+def get_optimizer(spec, learning_rate: float | None = None, **kwargs) -> optax.GradientTransformation:
+    """Resolve an optimizer spec: optax transform | name | (name, kwargs)."""
+    if isinstance(spec, optax.GradientTransformation):
+        return spec
+    if isinstance(spec, tuple):
+        name, kw = spec
+        return get_optimizer(name, **{**kw, **kwargs})
+    name = str(spec).lower()
+    lr = learning_rate if learning_rate is not None else kwargs.pop("lr", _DEFAULT_LR.get(name, 0.01))
+    if name == "sgd":
+        return optax.sgd(lr, momentum=kwargs.get("momentum", 0.0), nesterov=kwargs.get("nesterov", False))
+    if name == "momentum":
+        return optax.sgd(lr, momentum=kwargs.get("momentum", 0.9), nesterov=kwargs.get("nesterov", True))
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "adamw":
+        return optax.adamw(lr, weight_decay=kwargs.get("weight_decay", 1e-4))
+    if name == "adagrad":
+        return optax.adagrad(lr)
+    if name == "rmsprop":
+        return optax.rmsprop(lr)
+    raise ValueError(f"unknown optimizer {spec!r}")

@@ -1,0 +1,371 @@
+"""The SPMD training engine: windowed local SGD + collective commits.
+
+This module is the TPU-native replacement for the entire runtime half of the
+reference — the Spark job (``distkeras/trainers.py :: DistributedTrainer.train``
+shipping pickled Workers into executors), the worker training loop
+(``distkeras/workers.py :: *.train``), and the socket parameter-server service
+loop (``distkeras/parameter_servers.py :: SocketParameterServer.run``).
+
+Design (SURVEY.md §7):
+  * a worker = one position on the ``workers`` mesh axis; its local model
+    replica, optimizer state, and rule state are sharded along that axis;
+  * the parameter-server center variable is *replicated* across the axis;
+  * one epoch is a single jitted ``shard_map`` program: ``lax.scan`` over
+    commit windows, an inner ``lax.scan`` over local optimizer steps, and the
+    rule's ``commit`` (a ``psum`` over ICI + replicated center update) at each
+    window boundary — the TCP pull/commit round-trip of the reference becomes
+    one XLA collective per window;
+  * asynchrony is *modeled*: the staleness-simulation mode gives each worker
+    its own commit schedule (per-step masked commits), reproducing parameter-
+    server race semantics deterministically (SURVEY.md §7 "hard parts").
+
+Everything is static-shaped and trace-once; there is no per-step Python.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.algorithms.base import CommitCtx, UpdateRule
+from distkeras_tpu.models.adapter import ModelAdapter
+from distkeras_tpu.ops import get_loss, get_metric, get_optimizer
+from distkeras_tpu.parallel.mesh import replicated_sharding, worker_sharding
+from distkeras_tpu.utils.pytree import tree_cast, tree_where
+
+__all__ = ["TrainState", "WindowedEngine"]
+
+
+@struct.dataclass
+class TrainState:
+    """Full training state.  ``center_*`` leaves are replicated over the mesh;
+    all other leaves carry a leading ``[num_workers]`` axis sharded over it."""
+
+    center_params: Any
+    center_rule: Any
+    local_params: Any
+    opt_state: Any
+    model_state: Any
+    rule_local: Any
+    rng: jnp.ndarray
+    epoch: jnp.ndarray  # replicated scalar
+
+
+def _strip(tree):
+    """Drop the per-worker leading axis inside shard_map blocks."""
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def _unstrip(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+class WindowedEngine:
+    """Builds and owns the jitted epoch functions for one (model, rule) pair."""
+
+    def __init__(
+        self,
+        adapter: ModelAdapter,
+        loss,
+        worker_optimizer,
+        rule: UpdateRule,
+        mesh: Mesh,
+        *,
+        metrics: Sequence = ("accuracy",),
+        compute_dtype: Optional[Any] = None,
+        commit_schedule: Optional[np.ndarray] = None,
+        sync_model_state: bool = True,
+    ):
+        self.adapter = adapter
+        self.rule = rule
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.num_workers = mesh.devices.size
+        self.optimizer = get_optimizer(worker_optimizer)
+        self.loss_fn = get_loss(loss, from_logits=adapter.outputs_logits)
+        self.metric_fns = [get_metric(m) for m in metrics]
+        self.compute_dtype = compute_dtype
+        self.sync_model_state = sync_model_state
+        # Per-worker commit schedule (staleness simulation).  None => uniform
+        # synchronous windows, one collective per window.
+        self.commit_schedule = (
+            None if commit_schedule is None else np.asarray(commit_schedule, np.int32)
+        )
+        self._rep = replicated_sharding(mesh)
+        self._shard = worker_sharding(mesh)
+        self._epoch_fns = {}
+
+    # ------------------------------------------------------------------ init
+    def init_state(self, rng: jax.Array, sample_input) -> TrainState:
+        params, model_state = self.adapter.init(rng, sample_input)
+        n = self.num_workers
+
+        def _build(params, model_state):
+            center_rule = self.rule.init_center_state()
+            rule_local = self.rule.init_local_state(params)
+            tile = lambda t: jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
+            )
+            local_params = tile(params)
+            opt_state = jax.vmap(self.optimizer.init)(local_params)
+            rngs = jax.random.split(jax.random.fold_in(rng, 1), n)
+            return TrainState(
+                center_params=params,
+                center_rule=center_rule,
+                local_params=local_params,
+                opt_state=opt_state,
+                model_state=tile(model_state),
+                rule_local=tile(rule_local),
+                rng=rngs,
+                epoch=jnp.zeros((), jnp.int32),
+            )
+
+        shardings = TrainState(
+            center_params=self._rep,
+            center_rule=self._rep,
+            local_params=self._shard,
+            opt_state=self._shard,
+            model_state=self._shard,
+            rule_local=self._shard,
+            rng=self._shard,
+            epoch=self._rep,
+        )
+        with self.mesh:
+            return jax.jit(_build, out_shardings=shardings)(params, model_state)
+
+    # ------------------------------------------------------------- local step
+    def _local_step(self, carry, batch):
+        params, opt_state, model_state, rng = carry
+        rng, sub = jax.random.split(rng)
+        x, y = batch
+
+        def compute_loss(p, ms):
+            if self.compute_dtype is not None:
+                p = tree_cast(p, self.compute_dtype)
+                x_c = x.astype(self.compute_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+            else:
+                x_c = x
+            out, new_ms = self.adapter.apply(p, ms, x_c, training=True, rng=sub)
+            out = out.astype(jnp.float32)
+            loss = self.loss_fn(out, y)
+            mets = (
+                jnp.stack([m(out, y) for m in self.metric_fns])
+                if self.metric_fns
+                else jnp.zeros((0,), jnp.float32)
+            )
+            return loss, (new_ms, mets)
+
+        (loss, (model_state, mets)), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+            params, model_state
+        )
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state, model_state, rng), (loss, mets)
+
+    def _sync_model_state(self, ctx: CommitCtx, model_state):
+        if not self.sync_model_state or not jax.tree.leaves(model_state):
+            return model_state
+        mean = jax.tree.map(lambda x: ctx.psum(x) / self.num_workers, model_state)
+        return tree_where(ctx.mask, mean, model_state)
+
+    # ------------------------------------------------------- epoch (windowed)
+    def _make_epoch_fn(self, n_windows: int, window: int, do_commit: bool):
+        axis = self.axis
+        rule = self.rule
+
+        def worker_fn(center_params, center_rule, local, data):
+            local_params, opt_state, model_state, rule_local, rng = _strip(local)
+            xs, ys = _strip(data)
+            psum = lambda t: jax.tree.map(lambda v: lax.psum(v, axis), t)
+
+            def window_body(carry, wdata):
+                center_params, center_rule, local_params, opt_state, model_state, rule_local, rng = carry
+                (local_params, opt_state, model_state, rng), (losses, mets) = lax.scan(
+                    self._local_step, (local_params, opt_state, model_state, rng), wdata
+                )
+                if do_commit:
+                    ctx = CommitCtx(
+                        psum=psum,
+                        mask=jnp.asarray(True),
+                        steps_in_window=jnp.asarray(float(window)),
+                        num_workers=self.num_workers,
+                    )
+                    res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
+                    local_params, center_params = res.local_params, res.center_params
+                    rule_local, center_rule = res.local_state, res.center_state
+                    model_state = self._sync_model_state(ctx, model_state)
+                loss_mean = lax.psum(jnp.mean(losses), axis) / self.num_workers
+                mets_mean = lax.psum(jnp.mean(mets, axis=0), axis) / self.num_workers
+                carry = (center_params, center_rule, local_params, opt_state, model_state, rule_local, rng)
+                return carry, (loss_mean, mets_mean)
+
+            carry = (center_params, center_rule, local_params, opt_state, model_state, rule_local, rng)
+            carry, (losses, mets) = lax.scan(window_body, carry, (xs, ys))
+            center_params, center_rule, local_params, opt_state, model_state, rule_local, rng = carry
+            local_out = _unstrip((local_params, opt_state, model_state, rule_local, rng))
+            return center_params, center_rule, local_out, losses, mets
+
+        mapped = jax.shard_map(
+            worker_fn,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(self.axis), P(self.axis)),
+            out_specs=(P(), P(), P(self.axis), P(), P()),
+            check_vma=False,
+        )
+
+        def epoch_fn(state: TrainState, xs, ys):
+            local = (state.local_params, state.opt_state, state.model_state, state.rule_local, state.rng)
+            center_params, center_rule, local_out, losses, mets = mapped(
+                state.center_params, state.center_rule, local, (xs, ys)
+            )
+            local_params, opt_state, model_state, rule_local, rng = local_out
+            new_state = TrainState(
+                center_params=center_params,
+                center_rule=center_rule,
+                local_params=local_params,
+                opt_state=opt_state,
+                model_state=model_state,
+                rule_local=rule_local,
+                rng=rng,
+                epoch=state.epoch + 1,
+            )
+            return new_state, {"loss": losses, "metrics": mets}
+
+        return jax.jit(epoch_fn, donate_argnums=(0,))
+
+    # ---------------------------------------------- epoch (staleness-sim mode)
+    def _make_stepwise_epoch_fn(self, n_steps: int):
+        """Per-step masked commits with a per-worker schedule: the faithful
+        deterministic model of parameter-server asynchrony."""
+        axis = self.axis
+        rule = self.rule
+        schedule = jnp.asarray(self.commit_schedule, jnp.int32)  # [num_workers]
+
+        def worker_fn(center_params, center_rule, local, data, my_window):
+            local_params, opt_state, model_state, rule_local, rng = _strip(local)
+            xs, ys = _strip(data)
+            w = my_window.reshape(())  # this worker's commit period
+            psum = lambda t: jax.tree.map(lambda v: lax.psum(v, axis), t)
+
+            def step_body(carry, inp):
+                t, batch = inp
+                center_params, center_rule, local_params, opt_state, model_state, rule_local, rng, since = carry
+                (local_params, opt_state, model_state, rng), (loss, mets) = self._local_step(
+                    (local_params, opt_state, model_state, rng), batch
+                )
+                since = since + 1
+                mask = (t + 1) % w == 0
+                ctx = CommitCtx(
+                    psum=psum,
+                    mask=mask,
+                    steps_in_window=since.astype(jnp.float32),
+                    num_workers=self.num_workers,
+                )
+                res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
+                local_params, center_params = res.local_params, res.center_params
+                rule_local, center_rule = res.local_state, res.center_state
+                model_state = self._sync_model_state(ctx, model_state)
+                since = jnp.where(mask, 0, since)
+                loss_mean = lax.psum(loss, axis) / self.num_workers
+                carry = (center_params, center_rule, local_params, opt_state, model_state, rule_local, rng, since)
+                return carry, loss_mean
+
+            carry = (
+                center_params, center_rule, local_params, opt_state, model_state,
+                rule_local, rng, jnp.zeros((), jnp.int32),
+            )
+            carry, losses = lax.scan(step_body, carry, (jnp.arange(n_steps), (xs, ys)))
+            center_params, center_rule, local_params, opt_state, model_state, rule_local, rng, _ = carry
+            local_out = _unstrip((local_params, opt_state, model_state, rule_local, rng))
+            return center_params, center_rule, local_out, losses
+
+        mapped = jax.shard_map(
+            worker_fn,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(self.axis), P(self.axis), P(self.axis)),
+            out_specs=(P(), P(), P(self.axis), P()),
+            check_vma=False,
+        )
+
+        def epoch_fn(state: TrainState, xs, ys):
+            local = (state.local_params, state.opt_state, state.model_state, state.rule_local, state.rng)
+            center_params, center_rule, local_out, losses = mapped(
+                state.center_params, state.center_rule, local, (xs, ys), schedule
+            )
+            local_params, opt_state, model_state, rule_local, rng = local_out
+            new_state = TrainState(
+                center_params=center_params,
+                center_rule=center_rule,
+                local_params=local_params,
+                opt_state=opt_state,
+                model_state=model_state,
+                rule_local=rule_local,
+                rng=rng,
+                epoch=state.epoch + 1,
+            )
+            return new_state, {"loss": losses, "metrics": jnp.zeros((0,))}
+
+        return jax.jit(epoch_fn, donate_argnums=(0,))
+
+    # ----------------------------------------------------------------- public
+    def run_epoch(self, state: TrainState, xs: jnp.ndarray, ys: jnp.ndarray):
+        """Run one epoch.  ``xs``/``ys`` leading dims: [num_workers, n_windows,
+        window, batch] (uniform mode) or [num_workers, n_steps, batch]
+        (staleness mode)."""
+        if self.commit_schedule is not None:
+            key = ("step", xs.shape[1])
+            if key not in self._epoch_fns:
+                self._epoch_fns[key] = self._make_stepwise_epoch_fn(xs.shape[1])
+        else:
+            n_windows, window = xs.shape[1], xs.shape[2]
+            do_commit = self.rule.communication_window > 0
+            key = ("win", n_windows, window, do_commit)
+            if key not in self._epoch_fns:
+                self._epoch_fns[key] = self._make_epoch_fn(n_windows, window, do_commit)
+        with self.mesh:
+            return self._epoch_fns[key](state, xs, ys)
+
+    def average_workers(self, state: TrainState) -> TrainState:
+        """One-shot synchronous weight average (AveragingTrainer's final step)."""
+
+        def _avg(state):
+            mean_p = jax.tree.map(lambda x: jnp.mean(x, axis=0), state.local_params)
+            mean_ms = jax.tree.map(lambda x: jnp.mean(x, axis=0), state.model_state)
+            return state.replace(center_params=mean_p), mean_ms
+
+        with self.mesh:
+            new_state, mean_ms = jax.jit(
+                _avg,
+                out_shardings=(None, self._rep),
+            )(state)
+        return new_state, mean_ms
+
+    def final_model_state(self, state: TrainState):
+        """Replicated model state for the returned model (mean of workers)."""
+        with self.mesh:
+            return jax.jit(
+                lambda ms: jax.tree.map(lambda x: jnp.mean(x, axis=0), ms),
+                out_shardings=self._rep,
+            )(state.model_state)
+
+    def worker_slice(self, tree, index: int):
+        """Fetch one worker's slice of per-worker state to host (Ensemble path)."""
+        return jax.tree.map(lambda x: np.asarray(x[index]), tree)
+
+    # --------------------------------------------------------------- sharding
+    def shard_batches(self, xs: np.ndarray, ys: np.ndarray):
+        """Device-put epoch data with the per-worker sharding."""
+        with self.mesh:
+            return (
+                jax.device_put(xs, self._shard),
+                jax.device_put(ys, self._shard),
+            )

@@ -1,0 +1,65 @@
+"""Device-mesh construction — the TPU replacement for Spark's executor pool.
+
+In the reference, parallelism = Spark tasks (one Python worker per partition,
+``distkeras/trainers.py :: DistributedTrainer.train`` repartitions then calls
+``mapPartitionsWithIndex``).  Here a *worker* is a position along the
+``workers`` axis of a ``jax.sharding.Mesh``: worker-local state is sharded
+along that axis, the parameter-server center variable is replicated across it,
+and commit/pull round-trips become XLA collectives over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "WORKER_AXIS",
+    "make_mesh",
+    "worker_sharding",
+    "replicated_sharding",
+    "local_device_count",
+]
+
+WORKER_AXIS = "workers"
+
+
+def local_device_count() -> int:
+    return jax.device_count()
+
+
+def make_mesh(
+    num_workers: Optional[int] = None,
+    axis_name: str = WORKER_AXIS,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A 1-D data-parallel mesh of ``num_workers`` devices.
+
+    ``num_workers`` defaults to every visible device (the analogue of the
+    reference's ``num_workers`` trainer kwarg, except workers map 1:1 onto
+    chips instead of Spark tasks).  Multi-host processes contribute their
+    devices automatically via ``jax.devices()`` after
+    ``jax.distributed.initialize`` (see :mod:`distkeras_tpu.networking`).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_workers is None:
+        num_workers = len(devices)
+    if num_workers > len(devices):
+        raise ValueError(
+            f"num_workers={num_workers} exceeds visible devices ({len(devices)}). "
+            "On CPU, set XLA_FLAGS=--xla_force_host_platform_device_count=N."
+        )
+    return Mesh(np.array(devices[:num_workers]), (axis_name,))
+
+
+def worker_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for per-worker state: leading axis split over the worker axis."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the center variable: replicated on every worker."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,106 @@
+"""Parameter servers — the center-variable abstraction, TPU-native.
+
+In the reference these are driver-side TCP daemons
+(``distkeras/parameter_servers.py``): ``SocketParameterServer.run`` accepts
+worker connections and dispatches 1-byte action codes (``p``=pull sends the
+pickled center weights, ``c``=commit applies a delta), with subclasses
+defining the commit rule (``DeltaParameterServer``: ``center += delta``;
+``DynSGDParameterServer``: staleness-scaled).
+
+On TPU the center variable does not live on a host behind a socket — it is a
+*replicated pytree on the device mesh*, and commits are ``psum`` collectives
+inside the compiled program (see :mod:`distkeras_tpu.algorithms` for the
+update rules and :mod:`distkeras_tpu.parallel.engine` for the execution).
+These classes keep the reference's PS lifecycle/observability API
+(``start``/``stop``/``get_model``/``num_updates``) as a facade over that
+on-device state, so user code written against the reference keeps working.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from distkeras_tpu.algorithms import Adag, Downpour, DynSGD, UpdateRule
+
+__all__ = [
+    "ParameterServer",
+    "SocketParameterServer",
+    "DeltaParameterServer",
+    "ADAGParameterServer",
+    "DynSGDParameterServer",
+]
+
+
+class ParameterServer:
+    """Facade over the on-device replicated center variable."""
+
+    #: update rule applied at commit boundaries (subclass responsibility).
+    rule_class = Downpour
+
+    def __init__(self, model: Any = None, master_port: int = 5000):
+        self.model = model
+        self.master_port = master_port  # kept for API compat; no socket is opened
+        self.center_params: Any = None
+        self.center_model_state: Any = None
+        self._num_updates: int = 0
+        self.running = False
+
+    # -- lifecycle (reference parity: initialize/start/run/stop) ------------
+    def initialize(self) -> None:
+        """Reference parity: bound a listening socket.  Here: nothing to do —
+        the center variable is materialised on-device by the engine."""
+
+    def start(self) -> None:
+        self.running = True
+
+    def run(self) -> None:  # pragma: no cover - compat shim
+        self.running = True
+
+    def stop(self) -> None:
+        self.running = False
+
+    # -- state --------------------------------------------------------------
+    def attach(self, center_params, center_rule_state, center_model_state=None) -> None:
+        """Called by the trainer after training: adopt the final on-device
+        center state (the equivalent of the PS holding the trained model)."""
+        self.center_params = center_params
+        self.center_model_state = center_model_state
+        num = center_rule_state.get("num_updates") if isinstance(center_rule_state, dict) else None
+        if num is not None:
+            self._num_updates = int(np.asarray(num))
+
+    @property
+    def num_updates(self) -> int:
+        """Total commits applied to the center variable (reference parity:
+        ``ParameterServer.num_updates``)."""
+        return self._num_updates
+
+    def get_model(self):
+        """The trained center model (reference parity: ``get_model``)."""
+        return self.model
+
+
+class SocketParameterServer(ParameterServer):
+    """Name-parity alias: the reference's TCP accept-loop server.  All
+    transport concerns are gone — commits arrive as XLA collectives."""
+
+
+class DeltaParameterServer(SocketParameterServer):
+    """``center += delta`` (DOWNPOUR / AEASGD / EAMSGD commits)."""
+
+    rule_class = Downpour
+
+
+class ADAGParameterServer(SocketParameterServer):
+    """Window-normalised delta (``center += delta / window``)."""
+
+    rule_class = Adag
+
+
+class DynSGDParameterServer(SocketParameterServer):
+    """Staleness-aware: ``center += delta / (staleness + 1)`` with per-worker
+    update clocks (see :class:`distkeras_tpu.algorithms.DynSGD`)."""
+
+    rule_class = DynSGD

@@ -1,0 +1,428 @@
+"""Trainers — the user-facing API, signature-compatible with the reference.
+
+Reference surface (``distkeras/trainers.py``): construct a trainer around a
+compiled Keras model and call ``trainer.train(dataframe)`` to get a trained
+model back.  The class family is preserved exactly — ``SingleTrainer``,
+``AveragingTrainer``, ``EnsembleTrainer``, ``DistributedTrainer``,
+``AsynchronousDistributedTrainer``, ``DOWNPOUR``, ``AEASGD``, ``EAMSGD``,
+``ADAG``, ``DynSGD`` — as are the kwargs the notebooks use
+(``features_col``, ``label_col``, ``batch_size``, ``num_epoch``,
+``communication_window``, ``rho``, ``learning_rate``, ``momentum``,
+``num_workers``, ``master_port``, ``parallelism_factor``).
+
+What changed underneath: ``train`` no longer launches a Spark job against a
+socket parameter server — it compiles one SPMD program over a TPU mesh
+(:mod:`distkeras_tpu.parallel.engine`) where the PS center variable is
+replicated on-device and commits are ICI collectives.  Models may be Keras 3
+(JAX backend), flax modules, or adapters; Keras models are returned as Keras
+models with trained weights, matching the reference contract.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from distkeras_tpu import workers as workers_mod
+from distkeras_tpu.data import epoch_arrays
+from distkeras_tpu.frame import DataFrame
+from distkeras_tpu.models.adapter import ModelAdapter, TrainedModel, as_adapter
+from distkeras_tpu.parallel.engine import WindowedEngine
+from distkeras_tpu.parallel.mesh import make_mesh
+from distkeras_tpu.parameter_servers import (
+    ADAGParameterServer,
+    DeltaParameterServer,
+    DynSGDParameterServer,
+    ParameterServer,
+)
+
+__all__ = [
+    "Trainer",
+    "SingleTrainer",
+    "AveragingTrainer",
+    "EnsembleTrainer",
+    "DistributedTrainer",
+    "AsynchronousDistributedTrainer",
+    "DOWNPOUR",
+    "AEASGD",
+    "EAMSGD",
+    "ADAG",
+    "DynSGD",
+]
+
+
+class Trainer:
+    """Base trainer: model + loss + worker optimizer + wall-clock bookkeeping
+    (reference parity: ``trainers.py :: Trainer``)."""
+
+    def __init__(
+        self,
+        keras_model: Any,
+        loss: Any = "categorical_crossentropy",
+        worker_optimizer: Any = "sgd",
+        metrics: Sequence = ("accuracy",),
+        features_col: str = "features",
+        label_col: str = "label",
+        batch_size: int = 32,
+        num_epoch: int = 1,
+        seed: int = 0,
+        compute_dtype: Any = None,
+    ):
+        self.master_model = keras_model
+        self.loss = loss
+        self.worker_optimizer = worker_optimizer
+        self.metrics = tuple(metrics)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+        self.seed = seed
+        if isinstance(compute_dtype, str):
+            import jax.numpy as jnp
+
+            compute_dtype = jnp.dtype(compute_dtype)
+        self.compute_dtype = compute_dtype
+        self.history: dict = {}
+        self.training_time: float = 0.0
+        self._t0: Optional[float] = None
+
+    # -- wall-clock bookkeeping (reference parity) --------------------------
+    def record_training_start(self) -> None:
+        self._t0 = time.time()
+
+    def record_training_stop(self) -> None:
+        self.training_time = time.time() - (self._t0 or time.time())
+
+    def get_training_time(self) -> float:
+        return self.training_time
+
+    def get_history(self) -> dict:
+        return self.history
+
+    # -- internals ----------------------------------------------------------
+    def _load_columns(self, dataframe: DataFrame):
+        feats = dataframe.matrix(self.features_col, dtype=np.float32)
+        labels_raw = dataframe.column(self.label_col)
+        if labels_raw.dtype == object:
+            labels = dataframe.matrix(self.label_col, dtype=np.float32)
+        elif np.issubdtype(labels_raw.dtype, np.integer):
+            labels = labels_raw.astype(np.int32)
+        else:
+            labels = labels_raw.astype(np.float32)
+        # Integer token features (TextCNN) must stay integral.
+        f0 = dataframe.column(self.features_col)
+        if f0.dtype != object and np.issubdtype(f0.dtype, np.integer):
+            feats = f0.astype(np.int32)
+        return feats, labels
+
+    def _fit(
+        self,
+        dataframe: DataFrame,
+        rule,
+        num_workers: int,
+        *,
+        shuffle: bool = True,
+        average_at_end: bool = False,
+        commit_schedule: Optional[np.ndarray] = None,
+    ):
+        adapter = as_adapter(self.master_model)
+        feats, labels = self._load_columns(dataframe)
+        mesh = make_mesh(num_workers)
+        engine = WindowedEngine(
+            adapter,
+            self.loss,
+            self.worker_optimizer,
+            rule,
+            mesh,
+            metrics=self.metrics,
+            compute_dtype=self.compute_dtype,
+            commit_schedule=commit_schedule,
+        )
+        window = rule.communication_window if rule.communication_window > 0 else None
+        rng = np.random.default_rng(self.seed)
+        state = engine.init_state(jax.random.key(self.seed), feats[: self.batch_size])
+
+        losses_per_epoch: List[float] = []
+        metrics_per_epoch: List[np.ndarray] = []
+        self.record_training_start()
+        for _ in range(self.num_epoch):
+            if window is None:
+                # single window spanning the whole epoch (no commits)
+                from distkeras_tpu.data import plan_epoch
+
+                steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
+                xs, ys = epoch_arrays(
+                    feats, labels, num_workers, self.batch_size, steps,
+                    rng=rng if shuffle else None,
+                )
+            else:
+                xs, ys = epoch_arrays(
+                    feats, labels, num_workers, self.batch_size, window,
+                    stepwise=commit_schedule is not None,
+                    rng=rng if shuffle else None,
+                )
+            xs, ys = engine.shard_batches(xs, ys)
+            state, stats = engine.run_epoch(state, xs, ys)
+            losses_per_epoch.append(float(np.mean(np.asarray(stats["loss"]))))
+            m = np.asarray(stats["metrics"])
+            if m.size:
+                metrics_per_epoch.append(np.mean(m, axis=0))
+        if average_at_end:
+            state, _ = engine.average_workers(state)
+        self.record_training_stop()
+
+        self.history = {"loss": losses_per_epoch, "training_time": self.get_training_time()}
+        for i, name in enumerate(self.metrics):
+            if metrics_per_epoch:
+                key = name if isinstance(name, str) else getattr(name, "__name__", f"metric_{i}")
+                self.history[key] = [float(m[i]) for m in metrics_per_epoch]
+        return engine, state, adapter
+
+    def _finalize(self, engine: WindowedEngine, state, adapter: ModelAdapter, use_center: bool = True):
+        """Materialise the trained model in the same type the user passed in."""
+        if use_center:
+            params = jax.tree.map(np.asarray, state.center_params)
+        else:
+            params = engine.worker_slice(state.local_params, 0)
+        model_state = jax.tree.map(np.asarray, engine.final_model_state(state))
+        if hasattr(adapter, "assign"):  # Keras path: mutate + return the Keras model
+            return adapter.assign(params, model_state)
+        return TrainedModel(adapter, params, model_state, history=self.history)
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False):
+        raise NotImplementedError
+
+
+class SingleTrainer(Trainer):
+    """Single-worker baseline (reference parity: ``SingleTrainer`` — coalesce
+    to one partition, run a SequentialWorker)."""
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False):
+        worker = workers_mod.SequentialWorker(self.worker_optimizer, self.batch_size)
+        engine, state, adapter = self._fit(
+            dataframe, worker.rule, num_workers=1, shuffle=shuffle
+        )
+        return self._finalize(engine, state, adapter, use_center=False)
+
+
+class AveragingTrainer(Trainer):
+    """Synchronous one-shot weight averaging (reference parity:
+    ``AveragingTrainer.average_models``): N independent replicas, averaged once
+    at the end via a single ``pmean`` over the mesh."""
+
+    def __init__(self, *args, num_workers: int = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_workers = num_workers or jax.device_count()
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False):
+        worker = workers_mod.AveragingWorker(self.worker_optimizer, self.batch_size)
+        engine, state, adapter = self._fit(
+            dataframe, worker.rule, self.num_workers, shuffle=shuffle, average_at_end=True
+        )
+        return self._finalize(engine, state, adapter, use_center=True)
+
+
+class EnsembleTrainer(Trainer):
+    """Train N independent models, return all of them (reference parity:
+    ``EnsembleTrainer``)."""
+
+    def __init__(self, *args, num_models: int = 2, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_models = num_models
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False) -> List:
+        worker = workers_mod.SequentialWorker(self.worker_optimizer, self.batch_size)
+        engine, state, adapter = self._fit(
+            dataframe, worker.rule, self.num_models, shuffle=shuffle
+        )
+        model_state = jax.tree.map(np.asarray, engine.final_model_state(state))
+        out = []
+        for i in range(self.num_models):
+            params = engine.worker_slice(state.local_params, i)
+            if hasattr(adapter, "assign"):
+                import copy
+
+                out.append(TrainedModel(adapter, params, model_state, history=self.history))
+            else:
+                out.append(TrainedModel(adapter, params, model_state, history=self.history))
+        return out
+
+
+class DistributedTrainer(Trainer):
+    """Parameter-server training base (reference parity: ``DistributedTrainer``).
+
+    Owns the PS lifecycle (`service`/`stop_service` are retained as no-op-ish
+    facades over the on-device center variable) and the worker allocation
+    hook; subclasses pick the algorithm.
+    """
+
+    parameter_server_class = DeltaParameterServer
+
+    def __init__(
+        self,
+        keras_model: Any,
+        loss: Any = "categorical_crossentropy",
+        worker_optimizer: Any = "sgd",
+        metrics: Sequence = ("accuracy",),
+        num_workers: Optional[int] = None,
+        batch_size: int = 32,
+        features_col: str = "features",
+        label_col: str = "label",
+        num_epoch: int = 1,
+        master_port: int = 5000,
+        seed: int = 0,
+        compute_dtype: Any = None,
+        commit_schedule: Optional[Sequence[int]] = None,
+    ):
+        super().__init__(
+            keras_model, loss, worker_optimizer, metrics,
+            features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
+        )
+        self.num_workers = num_workers or jax.device_count()
+        self.master_port = master_port
+        self.parameter_server: Optional[ParameterServer] = None
+        # Optional per-worker commit periods: the deterministic staleness
+        # simulation (SURVEY.md §7 "asynchrony semantics on SPMD hardware").
+        self.commit_schedule = (
+            None if commit_schedule is None else np.asarray(commit_schedule, np.int32)
+        )
+
+    def allocate_worker(self) -> workers_mod.Worker:
+        raise NotImplementedError
+
+    def allocate_parameter_server(self) -> ParameterServer:
+        return self.parameter_server_class(self.master_model, self.master_port)
+
+    def service(self) -> None:
+        """Reference parity: started the PS thread.  Here the center variable
+        is created on-device by the engine; this just builds the facade."""
+        self.parameter_server = self.allocate_parameter_server()
+        self.parameter_server.start()
+
+    def stop_service(self) -> None:
+        if self.parameter_server is not None:
+            self.parameter_server.stop()
+
+    @property
+    def num_updates(self) -> int:
+        return self.parameter_server.num_updates if self.parameter_server else 0
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False):
+        worker = self.allocate_worker()
+        self.service()
+        engine, state, adapter = self._fit(
+            dataframe, worker.rule, self.num_workers, shuffle=shuffle,
+            commit_schedule=self.commit_schedule,
+        )
+        self.parameter_server.attach(
+            state.center_params, jax.tree.map(np.asarray, state.center_rule),
+        )
+        self.stop_service()
+        model = self._finalize(engine, state, adapter, use_center=True)
+        self.parameter_server.model = model
+        return model
+
+
+class AsynchronousDistributedTrainer(DistributedTrainer):
+    """Reference parity: adds ``parallelism_factor`` (Spark over-partitioning
+    so stragglers overlap).  On a synchronous mesh there are no stragglers; the
+    knob is kept for API compat and maps onto the staleness simulation."""
+
+    def __init__(self, *args, parallelism_factor: int = 1, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.parallelism_factor = parallelism_factor
+
+
+class DOWNPOUR(AsynchronousDistributedTrainer):
+    """Downpour SGD (Dean et al. 2012) — windowed delta commits."""
+
+    def __init__(self, *args, communication_window: int = 5, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.communication_window = communication_window
+
+    def allocate_worker(self):
+        return workers_mod.DOWNPOURWorker(
+            self.worker_optimizer, self.batch_size, self.features_col,
+            self.label_col, self.communication_window,
+        )
+
+
+class AEASGD(AsynchronousDistributedTrainer):
+    """Asynchronous Elastic Averaging SGD (Zhang et al. 2015)."""
+
+    def __init__(self, *args, communication_window: int = 32, rho: float = 5.0,
+                 learning_rate: float = 0.1, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.communication_window = communication_window
+        self.rho = rho
+        self.learning_rate = learning_rate
+
+    def allocate_worker(self):
+        return workers_mod.AEASGDWorker(
+            self.worker_optimizer, self.batch_size, self.features_col, self.label_col,
+            self.communication_window, self.rho, self.learning_rate,
+        )
+
+
+class EAMSGD(AsynchronousDistributedTrainer):
+    """Elastic Averaging with (Nesterov) momentum (Zhang et al. 2015)."""
+
+    def __init__(self, *args, communication_window: int = 32, rho: float = 5.0,
+                 learning_rate: float = 0.1, momentum: float = 0.9, **kwargs):
+        kwargs.setdefault("worker_optimizer", None)
+        super().__init__(*args, **kwargs)
+        self.communication_window = communication_window
+        self.rho = rho
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+
+    def allocate_worker(self):
+        return workers_mod.EAMSGDWorker(
+            self.worker_optimizer, self.batch_size, self.features_col, self.label_col,
+            self.communication_window, self.rho, self.learning_rate, self.momentum,
+        )
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False):
+        # default worker optimizer = Nesterov momentum SGD (the reference's
+        # explicit velocity update on the local variable)
+        if self.worker_optimizer is None:
+            self.worker_optimizer = (
+                "sgd",
+                {"learning_rate": self.learning_rate, "momentum": self.momentum, "nesterov": True},
+            )
+        return super().train(dataframe, shuffle)
+
+
+class ADAG(AsynchronousDistributedTrainer):
+    """Accumulated-Gradient Normalisation (Hermans, arXiv:1710.02368)."""
+
+    parameter_server_class = ADAGParameterServer
+
+    def __init__(self, *args, communication_window: int = 12, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.communication_window = communication_window
+
+    def allocate_worker(self):
+        return workers_mod.ADAGWorker(
+            self.worker_optimizer, self.batch_size, self.features_col,
+            self.label_col, self.communication_window,
+        )
+
+
+class DynSGD(AsynchronousDistributedTrainer):
+    """Staleness-aware dynamic-LR SGD (SIGMOD'17 rule)."""
+
+    parameter_server_class = DynSGDParameterServer
+
+    def __init__(self, *args, communication_window: int = 5, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.communication_window = communication_window
+
+    def allocate_worker(self):
+        return workers_mod.DynSGDWorker(
+            self.worker_optimizer, self.batch_size, self.features_col,
+            self.label_col, self.communication_window,
+        )

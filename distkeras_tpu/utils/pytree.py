@@ -1,0 +1,74 @@
+"""Pytree arithmetic helpers used by the update rules and engines.
+
+The reference operates on lists of numpy weight arrays (``distkeras/utils.py``
+and the residual arithmetic inside ``distkeras/workers.py``).  Here model
+parameters are JAX pytrees, so the same arithmetic is expressed with
+``jax.tree_util`` maps; every helper is jit-safe and works on arbitrary
+nested structures.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "tree_add",
+    "tree_sub",
+    "tree_scale",
+    "tree_add_scaled",
+    "tree_zeros_like",
+    "tree_ones_like",
+    "tree_global_norm",
+    "tree_size",
+    "tree_cast",
+    "tree_where",
+]
+
+
+def tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a, b):
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(a, s):
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def tree_add_scaled(a, b, s):
+    """a + s * b, fused per-leaf."""
+    return jax.tree.map(lambda x, y: x + s * y, a, b)
+
+
+def tree_zeros_like(a):
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+def tree_ones_like(a):
+    return jax.tree.map(jnp.ones_like, a)
+
+
+def tree_global_norm(a):
+    leaves = jax.tree.leaves(a)
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))
+
+
+def tree_size(a) -> int:
+    """Total number of scalar parameters."""
+    return sum(x.size for x in jax.tree.leaves(a))
+
+
+def tree_cast(a, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, a
+    )
+
+
+def tree_where(pred, a, b):
+    """Per-leaf select; ``pred`` is a scalar boolean (jit-safe)."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)

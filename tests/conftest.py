@@ -1,0 +1,36 @@
+"""Test configuration: fake an 8-device CPU mesh before any backend init.
+
+This is the rebuild's analogue of the reference's Spark ``local[N]`` mode
+(SURVEY.md §4): the full distributed protocol runs on one machine by making
+XLA expose N host devices, so every collective path (commit psums, center
+replication, staleness clocks) is exercised without TPU hardware.
+
+Env vars alone are not enough here: the sandbox pre-imports jax with
+JAX_PLATFORMS pointing at the TPU tunnel, so we must override through
+``jax.config`` before the first backend query.
+"""
+
+import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def toy_classification():
+    """Small linearly-separable 2-class problem: fast convergence checks."""
+    rng = np.random.default_rng(0)
+    n = 512
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8,))
+    y = (x @ w > 0).astype(np.int32)
+    onehot = np.zeros((n, 2), np.float32)
+    onehot[np.arange(n), y] = 1.0
+    return x, y, onehot

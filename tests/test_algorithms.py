@@ -1,0 +1,141 @@
+"""Closed-form unit tests for the update rules (SURVEY.md §3.3 math)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distkeras_tpu.algorithms import (
+    Adag,
+    Aeasgd,
+    Downpour,
+    DynSGD,
+    Eamsgd,
+    OneShotAverage,
+    make_ctx,
+)
+from distkeras_tpu.parallel.mesh import make_mesh
+
+
+def params_like(v):
+    return {"w": jnp.asarray(v, jnp.float32), "b": jnp.asarray([v * 2.0], jnp.float32)}
+
+
+def test_downpour_commit_center_plus_delta_and_pull():
+    rule = Downpour(communication_window=5)
+    center = params_like(1.0)
+    local = params_like(1.5)  # drifted +0.5 from anchor==center
+    st = rule.init_local_state(center)
+    cst = rule.init_center_state()
+    res = rule.commit(make_ctx(), local, center, st, cst)
+    np.testing.assert_allclose(res.center_params["w"], 1.5)
+    # pulled: local adopts new center
+    np.testing.assert_allclose(res.local_params["w"], 1.5)
+    np.testing.assert_allclose(res.local_state["anchor"]["w"], 1.5)
+    assert int(res.center_state["num_updates"]) == 1
+
+
+def test_downpour_masked_commit_is_noop():
+    rule = Downpour()
+    center = params_like(1.0)
+    local = params_like(2.0)
+    res = rule.commit(
+        make_ctx(mask=False), local, center, rule.init_local_state(center),
+        rule.init_center_state(),
+    )
+    np.testing.assert_allclose(res.center_params["w"], 1.0)
+    np.testing.assert_allclose(res.local_params["w"], 2.0)  # no pull
+    assert int(res.center_state["num_updates"]) == 0
+
+
+def test_adag_normalizes_by_window():
+    rule = Adag(communication_window=4)
+    center = params_like(0.0)
+    local = params_like(2.0)
+    res = rule.commit(
+        make_ctx(steps_in_window=4), local, center,
+        rule.init_local_state(center), rule.init_center_state(),
+    )
+    np.testing.assert_allclose(res.center_params["w"], 0.5)  # 2.0 / 4
+
+
+def test_aeasgd_elastic_symmetry():
+    rule = Aeasgd(communication_window=8, rho=2.0, learning_rate=0.1)
+    alpha = rule.alpha
+    center = params_like(0.0)
+    local = params_like(1.0)
+    res = rule.commit(make_ctx(), local, center, (), rule.init_center_state())
+    np.testing.assert_allclose(res.local_params["w"], 1.0 - alpha * 1.0, rtol=1e-6)
+    np.testing.assert_allclose(res.center_params["w"], alpha * 1.0, rtol=1e-6)
+    # elastic force conserves the sum (x + c unchanged)
+    np.testing.assert_allclose(
+        res.local_params["w"] + res.center_params["w"], 1.0, rtol=1e-6
+    )
+
+
+def test_eamsgd_same_commit_rule():
+    a = Aeasgd(communication_window=8, rho=1.0, learning_rate=0.2)
+    m = Eamsgd(communication_window=8, rho=1.0, learning_rate=0.2, momentum=0.9)
+    center, local = params_like(0.0), params_like(1.0)
+    ra = a.commit(make_ctx(), local, center, (), a.init_center_state())
+    rm = m.commit(make_ctx(), local, center, (), m.init_center_state())
+    np.testing.assert_allclose(ra.center_params["w"], rm.center_params["w"])
+
+
+def test_dynsgd_staleness_scaling():
+    rule = DynSGD(communication_window=5)
+    center = params_like(0.0)
+    local = params_like(1.0)
+    st = rule.init_local_state(center)
+    cst = {"num_updates": jnp.asarray(3, jnp.int32)}  # 3 commits happened since my pull
+    res = rule.commit(make_ctx(), local, center, st, cst)
+    # delta scaled by 1/(staleness+1) = 1/4
+    np.testing.assert_allclose(res.center_params["w"], 0.25)
+    assert int(res.center_state["num_updates"]) == 4
+    assert int(res.local_state["clock"]) == 4  # pulled: clock catches up
+
+
+def test_dynsgd_zero_staleness_equals_downpour():
+    dyn, dp = DynSGD(), Downpour()
+    center, local = params_like(0.0), params_like(0.7)
+    r1 = dyn.commit(make_ctx(), local, center, dyn.init_local_state(center), dyn.init_center_state())
+    r2 = dp.commit(make_ctx(), local, center, dp.init_local_state(center), dp.init_center_state())
+    np.testing.assert_allclose(r1.center_params["w"], r2.center_params["w"])
+
+
+@pytest.mark.parametrize("rule_cls", [Downpour, Adag, DynSGD])
+def test_multi_worker_psum_commit(rule_cls):
+    """Two workers on a real (faked-CPU) mesh: commits sum over the axis."""
+    mesh = make_mesh(2)
+    rule = rule_cls(communication_window=1)
+    center = params_like(0.0)
+
+    def worker(local_w):
+        local_w = local_w.reshape(())
+        local = {"w": local_w, "b": jnp.stack([local_w * 2.0])}
+        ctx = make_ctx(axis_name="workers", steps_in_window=1, num_workers=2)
+        res = rule.commit(ctx, local, center, rule.init_local_state(center), rule.init_center_state())
+        return res.center_params["w"].reshape(1)
+
+    f = jax.shard_map(worker, mesh=mesh, in_specs=P("workers"), out_specs=P("workers"),
+                      check_vma=False)
+    out = np.asarray(f(jnp.asarray([0.5, 0.25], jnp.float32)))
+    # both workers agree on the center: 0.5 + 0.25 (scaled 1 for staleness 0 / window 1)
+    np.testing.assert_allclose(out, [0.75, 0.75], rtol=1e-6)
+
+
+def test_oneshot_average():
+    mesh = make_mesh(4)
+    rule = OneShotAverage()
+
+    def worker(local_w):
+        local = {"w": local_w.reshape(())}
+        ctx = make_ctx(axis_name="workers", num_workers=4)
+        res = rule.commit(ctx, local, {"w": jnp.zeros(())}, (), rule.init_center_state())
+        return res.center_params["w"].reshape(1)
+
+    f = jax.shard_map(worker, mesh=mesh, in_specs=P("workers"), out_specs=P("workers"),
+                      check_vma=False)
+    out = np.asarray(f(jnp.asarray([1.0, 2.0, 3.0, 4.0], jnp.float32)))
+    np.testing.assert_allclose(out, [2.5] * 4)

@@ -1,0 +1,65 @@
+import numpy as np
+import pytest
+
+from distkeras_tpu.frame import DataFrame, from_numpy, from_rows
+
+
+def test_basic_construction_and_schema():
+    df = from_numpy(np.zeros((10, 4)), np.arange(10))
+    assert df.columns == ["features", "label"]
+    assert len(df) == 10 and df.count() == 10
+    assert "features" in df
+
+
+def test_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        DataFrame({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_select_with_column_drop_rename():
+    df = from_numpy(np.ones((5, 2)), np.zeros(5))
+    df2 = df.with_column("pred", np.arange(5))
+    assert set(df2.columns) == {"features", "label", "pred"}
+    assert df2.select("pred").columns == ["pred"]
+    assert "label" not in df2.drop("label")
+    assert "y" in df2.rename("label", "y")
+    # original untouched (immutability)
+    assert "pred" not in df
+
+
+def test_filter_sample_shuffle_limit_union():
+    df = from_numpy(np.arange(20).reshape(20, 1), np.arange(20))
+    even = df.filter(df["label"] % 2 == 0)
+    assert len(even) == 10
+    assert len(df.filter(lambda r: r.label < 5)) == 5
+    assert len(df.limit(7)) == 7
+    shuffled = df.shuffle(seed=1)
+    assert sorted(shuffled["label"].tolist()) == list(range(20))
+    assert len(df.union(even)) == 30
+
+
+def test_partitions_cover_all_rows():
+    df = from_numpy(np.arange(10).reshape(10, 1), np.arange(10)).repartition(3)
+    parts = list(df.partitions())
+    assert len(parts) == 3
+    assert sum(len(p) for p in parts) == 10
+
+
+def test_rows_and_collect():
+    df = from_rows([{"a": 1, "b": 2.0}, {"a": 3, "b": 4.0}])
+    rows = df.collect()
+    assert rows[0].a == 1 and rows[1]["b"] == 4.0
+    assert df.first().asDict() == {"a": 1, "b": 2.0}
+
+
+def test_ragged_object_column_and_matrix():
+    df = from_rows([{"v": [1.0, 2.0]}, {"v": [3.0, 4.0]}])
+    m = df.matrix("v")
+    assert m.shape == (2, 2) and m.dtype == np.float32
+
+
+def test_random_split():
+    df = from_numpy(np.zeros((100, 1)), np.zeros(100))
+    a, b = df.randomSplit([0.7, 0.3], seed=0)
+    assert len(a) + len(b) == 100
+    assert 50 < len(a) < 90

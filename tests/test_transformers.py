@@ -1,0 +1,69 @@
+import numpy as np
+
+from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
+from distkeras_tpu.frame import from_numpy, from_rows
+from distkeras_tpu.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+    StandardScaleTransformer,
+)
+
+
+def test_label_index_transformer():
+    df = from_numpy(np.eye(3, dtype=np.float32)[[2, 0, 1]], np.zeros(3))
+    out = LabelIndexTransformer(3, input_col="features", output_col="idx").transform(df)
+    assert out["idx"].tolist() == [2, 0, 1]
+
+
+def test_one_hot_transformer():
+    df = from_numpy(np.zeros((4, 1)), np.array([0, 2, 1, 2]))
+    out = OneHotTransformer(3, input_col="label", output_col="oh").transform(df)
+    assert out["oh"].shape == (4, 3)
+    assert out["oh"][1].tolist() == [0.0, 0.0, 1.0]
+
+
+def test_min_max_transformer():
+    x = np.array([[0.0], [127.5], [255.0]], np.float32)
+    df = from_numpy(x, np.zeros(3))
+    out = MinMaxTransformer(0.0, 1.0, 0.0, 255.0).transform(df)
+    np.testing.assert_allclose(out["features_normalized"].reshape(-1), [0, 0.5, 1.0])
+
+
+def test_reshape_transformer():
+    df = from_numpy(np.zeros((2, 784), np.float32), np.zeros(2))
+    out = ReshapeTransformer("features", "matrix", (28, 28, 1)).transform(df)
+    assert out["matrix"].shape == (2, 28, 28, 1)
+
+
+def test_dense_transformer_object_column():
+    df = from_rows([{"features": [1.0, 0.0]}, {"features": [0.0, 2.0]}])
+    out = DenseTransformer().transform(df)
+    assert out["features_dense"].shape == (2, 2)
+
+
+def test_standard_scale():
+    x = np.random.default_rng(0).normal(5.0, 3.0, size=(100, 4)).astype(np.float32)
+    out = StandardScaleTransformer().transform(from_numpy(x, np.zeros(100)))
+    z = out["features_standardized"]
+    np.testing.assert_allclose(z.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(z.std(axis=0), 1.0, atol=1e-4)
+
+
+def test_accuracy_evaluator_index_and_vector_forms():
+    df = from_numpy(np.zeros((4, 1)), np.array([0, 1, 1, 0]))
+    df = df.with_column("prediction", np.array([0, 1, 0, 0]))
+    assert AccuracyEvaluator().evaluate(df) == 0.75
+    # vector predictions
+    probs = np.eye(2, dtype=np.float32)[[0, 1, 0, 0]]
+    df2 = df.with_column("prediction", probs)
+    assert AccuracyEvaluator().evaluate(df2) == 0.75
+
+
+def test_loss_evaluator():
+    df = from_numpy(np.zeros((2, 1)), np.eye(2, dtype=np.float32))
+    df = df.with_column("prediction", np.array([[0.9, 0.1], [0.1, 0.9]], np.float32))
+    loss = LossEvaluator(label_col="label").evaluate(df)
+    assert 0 < loss < 0.2
