@@ -1,0 +1,133 @@
+"""End-to-end trainer tests on the faked 8-device CPU mesh.
+
+The reference's only 'tests' were its example notebooks run under Spark
+local[N] (SURVEY.md §4); these tests are the pytest form of that: every
+trainer trains a small model on a toy problem end-to-end and must (a) return
+a working model, (b) beat chance accuracy, (c) keep its reference API
+surface (history, training time, parameter-server counters).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.models import MLP, FlaxModel
+from distkeras_tpu.predictors import ModelPredictor
+
+
+def make_df(toy):
+    x, y, onehot = toy
+    return from_numpy(x, onehot)
+
+
+def model():
+    return FlaxModel(MLP(features=(16,), num_classes=2))
+
+
+def accuracy_of(trained, toy):
+    x, y, _ = toy
+    preds = trained.predict(x)
+    return float(np.mean(np.argmax(preds, -1) == y))
+
+
+def test_single_trainer_end_to_end(toy_classification):
+    df = make_df(toy_classification)
+    t = dk.SingleTrainer(model(), loss="categorical_crossentropy",
+                         worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                         batch_size=32, num_epoch=12)
+    trained = t.train(df)
+    assert accuracy_of(trained, toy_classification) > 0.85
+    assert t.get_training_time() > 0
+    assert len(t.get_history()["loss"]) == 12
+    # loss decreases
+    h = t.get_history()["loss"]
+    assert h[-1] < h[0]
+
+
+@pytest.mark.parametrize("trainer_cls,kwargs", [
+    (dk.DOWNPOUR, {"communication_window": 4}),
+    (dk.ADAG, {"communication_window": 4}),
+    (dk.AEASGD, {"communication_window": 4, "rho": 1.0, "learning_rate": 0.05}),
+    (dk.EAMSGD, {"communication_window": 4, "rho": 1.0, "learning_rate": 0.05,
+                 "momentum": 0.5}),
+    (dk.DynSGD, {"communication_window": 4}),
+])
+def test_distributed_trainers_converge(toy_classification, trainer_cls, kwargs):
+    df = make_df(toy_classification)
+    t = trainer_cls(model(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=4, batch_size=16, num_epoch=10, **kwargs)
+    trained = t.train(df)
+    assert accuracy_of(trained, toy_classification) > 0.85
+    assert t.num_updates > 0  # parameter-server counter advanced
+    assert t.parameter_server.get_model() is trained
+
+
+def test_averaging_trainer(toy_classification):
+    df = make_df(toy_classification)
+    t = dk.AveragingTrainer(model(), loss="categorical_crossentropy",
+                            worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                            num_workers=4, batch_size=16, num_epoch=10)
+    trained = t.train(df)
+    assert accuracy_of(trained, toy_classification) > 0.8
+
+
+def test_ensemble_trainer_returns_n_models(toy_classification):
+    df = make_df(toy_classification)
+    t = dk.EnsembleTrainer(model(), loss="categorical_crossentropy",
+                           worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                           num_models=3, batch_size=16, num_epoch=6)
+    models = t.train(df)
+    assert len(models) == 3
+    for m in models:
+        assert accuracy_of(m, toy_classification) > 0.7
+    # independent models differ
+    p0 = jax.tree.leaves(models[0].params)[0]
+    p1 = jax.tree.leaves(models[1].params)[0]
+    assert not np.allclose(p0, p1)
+
+
+def test_downpour_determinism(toy_classification):
+    """XLA collectives are deterministic — same seed, same result (the
+    property the reference's hogwild PS could never have; SURVEY.md §5.2)."""
+    df = make_df(toy_classification)
+
+    def run():
+        t = dk.DOWNPOUR(model(), loss="categorical_crossentropy",
+                        worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                        num_workers=4, batch_size=16, num_epoch=2,
+                        communication_window=4, seed=7)
+        return t.train(df)
+
+    a, b = run(), run()
+    for la, lb in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_staleness_schedule_dynsgd(toy_classification):
+    """Heterogeneous commit schedules: the deterministic async simulation."""
+    df = make_df(toy_classification)
+    t = dk.DynSGD(model(), loss="categorical_crossentropy",
+                  worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                  num_workers=4, batch_size=16, num_epoch=8,
+                  commit_schedule=[2, 4, 4, 8])
+    trained = t.train(df)
+    assert accuracy_of(trained, toy_classification) > 0.8
+    assert t.num_updates > 0
+
+
+def test_predictor_integration(toy_classification):
+    x, y, onehot = toy_classification
+    df = make_df(toy_classification)
+    t = dk.SingleTrainer(model(), loss="categorical_crossentropy",
+                         worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                         batch_size=32, num_epoch=8)
+    trained = t.train(df)
+    pred_df = ModelPredictor(trained).predict(df)
+    assert "prediction" in pred_df
+    out = dk.LabelIndexTransformer(2, input_col="prediction", output_col="p_idx").transform(pred_df)
+    out = out.with_column("y", y)
+    acc = dk.AccuracyEvaluator(prediction_col="p_idx", label_col="y").evaluate(out)
+    assert acc > 0.85
