@@ -1,0 +1,86 @@
+"""Headline benchmark: CIFAR-10 CNN DOWNPOUR throughput (samples/sec/chip).
+
+This is the `BASELINE.json` metric ("CIFAR-10 CNN samples/sec/chip").  The
+reference published no machine-readable numbers (`published: {}` — see
+BASELINE.md), so `vs_baseline` is reported against the pinned value in
+`bench_baseline.json` (first recorded run of this benchmark on a v5e chip);
+>1.0 means faster than that pin.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+
+
+def main():
+    import jax
+
+    from distkeras_tpu.algorithms import Downpour
+    from distkeras_tpu.models import CIFARCNN, FlaxModel
+    from distkeras_tpu.parallel.engine import WindowedEngine
+    from distkeras_tpu.parallel.mesh import make_mesh
+
+    num_workers = jax.device_count()
+    mesh = make_mesh(num_workers)
+    batch = 256          # per-worker batch
+    window = 16          # commit window (local steps between collectives)
+    n_windows = 8        # windows per timed epoch
+    steps = n_windows * window
+
+    adapter = FlaxModel(CIFARCNN())
+    engine = WindowedEngine(
+        adapter,
+        loss="categorical_crossentropy",
+        worker_optimizer=("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+        rule=Downpour(communication_window=window),
+        mesh=mesh,
+        metrics=(),
+        compute_dtype=jax.numpy.bfloat16,
+    )
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(num_workers, n_windows, window, batch, 32, 32, 3)).astype(np.float32)
+    ys = rng.integers(0, 10, size=(num_workers, n_windows, window, batch)).astype(np.int32)
+    state = engine.init_state(jax.random.key(0), xs[0, 0, 0])
+    xs, ys = engine.shard_batches(xs, ys)
+
+    # Warmup: compile + one full epoch.
+    state, _ = engine.run_epoch(state, xs, ys)
+    jax.block_until_ready(state.center_params)
+
+    # Timed epochs.
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, stats = engine.run_epoch(state, xs, ys)
+    jax.block_until_ready(state.center_params)
+    dt = time.perf_counter() - t0
+
+    samples = reps * num_workers * steps * batch
+    sps_per_chip = samples / dt / num_workers
+
+    vs = 1.0
+    if os.path.exists(BASELINE_FILE):
+        try:
+            pinned = json.load(open(BASELINE_FILE))["samples_per_sec_per_chip"]
+            vs = sps_per_chip / pinned
+        except Exception:
+            pass
+    print(json.dumps({
+        "metric": "cifar10_cnn_downpour_samples_per_sec_per_chip",
+        "value": round(sps_per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
