@@ -49,7 +49,7 @@ def main():
     rng = np.random.default_rng(0)
     xs = rng.normal(size=(num_workers, n_windows, window, batch, 32, 32, 3)).astype(np.float32)
     ys = rng.integers(0, 10, size=(num_workers, n_windows, window, batch)).astype(np.int32)
-    state = engine.init_state(jax.random.key(0), xs[0, 0, 0])
+    state = engine.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
     xs, ys = engine.shard_batches(xs, ys)
 
     # Warmup: compile + one full epoch.

@@ -1,0 +1,100 @@
+"""Checkpoint / resume — mid-training persistence of the center variable.
+
+The reference has nothing in-tree (SURVEY.md §5.4: users call
+``model.save()`` on the returned Keras model; a dead parameter server loses
+the run).  Here the full training state — center params, per-worker local
+replicas, optimizer state, rule state (clocks/anchors), epoch counter —
+checkpoints through Orbax, so an interrupted distributed run resumes exactly
+(bitwise, given the same data order seed).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step", "CheckpointManager"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(directory: str, state: Any, step: int) -> str:
+    """Write training state under ``directory/step_N``; returns the path."""
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    _checkpointer().save(path, jax.tree.map(np.asarray, state))
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(d.split("_", 1)[1])
+        for d in os.listdir(directory)
+        if d.startswith("step_") and d.split("_", 1)[1].isdigit()
+    ]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(directory: str, step: Optional[int] = None, like: Any = None) -> Any:
+    """Load training state; ``like`` (a template pytree, e.g. a freshly built
+    TrainState) restores exact structure/dtypes and device placement."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    restored = _checkpointer().restore(path, item=jax.tree.map(np.asarray, like) if like is not None else None)
+    if like is not None:
+        # re-place on the same shardings as the template
+        return jax.tree.map(
+            lambda tpl, val: jax.device_put(val, tpl.sharding)
+            if hasattr(tpl, "sharding")
+            else val,
+            like,
+            restored,
+        )
+    return restored
+
+
+class CheckpointManager:
+    """Every-N-epochs checkpointing hook used by trainers (``checkpoint_dir``
+    + ``checkpoint_every`` kwargs)."""
+
+    def __init__(self, directory: str, every: int = 1, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.every = max(1, int(every))
+        self.keep = keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def maybe_save(self, state: Any, epoch: int) -> Optional[str]:
+        if (epoch + 1) % self.every:
+            return None
+        path = save_checkpoint(self.directory, state, epoch + 1)
+        self._gc()
+        return path
+
+    def _gc(self) -> None:
+        steps = sorted(
+            int(d.split("_", 1)[1])
+            for d in os.listdir(self.directory)
+            if d.startswith("step_") and d.split("_", 1)[1].isdigit()
+        )
+        import shutil
+
+        for s in steps[: -self.keep] if self.keep else []:
+            shutil.rmtree(os.path.join(self.directory, f"step_{s}"), ignore_errors=True)
+
+    def latest(self) -> Optional[int]:
+        return latest_step(self.directory)
+
+    def restore(self, like: Any = None, step: Optional[int] = None) -> Any:
+        return restore_checkpoint(self.directory, step, like)

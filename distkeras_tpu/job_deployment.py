@@ -1,0 +1,186 @@
+"""Job deployment — the reference's experimental "Punchcard" subsystem.
+
+Reference parity: ``distkeras/job_deployment.py :: Job`` packages a training
+script plus data pointer plus a shared secret and ships it to a remote
+Punchcard daemon that runs queued jobs (SURVEY.md L7; explicitly experimental
+and off the main path — same status here).
+
+This implementation: :class:`PunchcardServer` is a small TCP daemon with a
+FIFO queue and one runner thread; :class:`Job` is the client.  Transport uses
+:mod:`distkeras_tpu.networking`'s restricted codec (no pickle).  Submitted
+code executes with the daemon's privileges — the shared secret gates access,
+so deploy only inside a trusted cluster, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import uuid
+from typing import Any, Dict, Optional
+
+from distkeras_tpu.networking import connect, recv_data, send_data
+
+__all__ = ["Job", "PunchcardServer"]
+
+DEFAULT_PORT = 8000
+
+
+class PunchcardServer:
+    """Queue-and-run daemon for packaged training jobs."""
+
+    def __init__(self, port: int = DEFAULT_PORT, secret: str = "", workdir: Optional[str] = None):
+        self.port = port
+        self.secret = secret
+        self.workdir = workdir or tempfile.mkdtemp(prefix="punchcard_")
+        self.jobs: Dict[str, dict] = {}
+        self._queue: list[str] = []
+        self._cv = threading.Condition()
+        self._running = False
+        self._sock: Optional[socket.socket] = None
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", self.port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(16)
+        self._running = True
+        for target in (self._accept_loop, self._runner_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False
+        with self._cv:
+            self._cv.notify_all()
+        if self._sock is not None:
+            try:  # self-connect to unblock accept() — the reference's cancel_accept trick
+                socket.create_connection(("127.0.0.1", self.port), timeout=1).close()
+            except OSError:
+                pass
+            self._sock.close()
+
+    # -- server internals ---------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            if not self._running:
+                conn.close()
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _authorized(self, msg: dict) -> bool:
+        return hmac.compare_digest(str(msg.get("secret", "")), self.secret)
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            msg = recv_data(conn)
+            if not self._authorized(msg):
+                send_data(conn, {"status": "denied"})
+                return
+            action = msg.get("action")
+            if action == "submit":
+                job_id = uuid.uuid4().hex
+                self.jobs[job_id] = {"status": "queued", "output": "", "returncode": None,
+                                     "script": msg["script"], "args": msg.get("args", [])}
+                with self._cv:
+                    self._queue.append(job_id)
+                    self._cv.notify()
+                send_data(conn, {"status": "queued", "job_id": job_id})
+            elif action == "status":
+                job = self.jobs.get(msg.get("job_id", ""))
+                if job is None:
+                    send_data(conn, {"status": "unknown"})
+                else:
+                    send_data(conn, {"status": job["status"], "output": job["output"],
+                                     "returncode": job["returncode"]})
+            elif action == "list":
+                send_data(conn, {"status": "ok",
+                                 "jobs": {k: v["status"] for k, v in self.jobs.items()}})
+            else:
+                send_data(conn, {"status": "bad_request"})
+        except (ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _runner_loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and not self._queue:
+                    self._cv.wait(timeout=0.5)
+                if not self._running:
+                    return
+                job_id = self._queue.pop(0)
+            job = self.jobs[job_id]
+            job["status"] = "running"
+            script_path = os.path.join(self.workdir, f"{job_id}.py")
+            with open(script_path, "w") as f:
+                f.write(job["script"])
+            try:
+                proc = subprocess.run(
+                    [sys.executable, script_path, *map(str, job["args"])],
+                    capture_output=True, text=True, timeout=3600, cwd=self.workdir,
+                )
+                job["output"] = proc.stdout + proc.stderr
+                job["returncode"] = proc.returncode
+                job["status"] = "finished" if proc.returncode == 0 else "failed"
+            except subprocess.TimeoutExpired:
+                job["status"] = "timeout"
+
+
+class Job:
+    """Client: package a training script, submit it, poll for the result
+    (reference parity: ``job_deployment.py :: Job``)."""
+
+    def __init__(self, host: str, port: int = DEFAULT_PORT, secret: str = "",
+                 script: str = "", args: Optional[list] = None):
+        self.host = host
+        self.port = port
+        self.secret = secret
+        self.script = script
+        self.args = args or []
+        self.job_id: Optional[str] = None
+
+    def _rpc(self, message: dict) -> Any:
+        sock = connect(self.host, self.port)
+        try:
+            send_data(sock, {**message, "secret": self.secret})
+            return recv_data(sock)
+        finally:
+            sock.close()
+
+    def submit(self) -> str:
+        reply = self._rpc({"action": "submit", "script": self.script, "args": self.args})
+        if reply.get("status") != "queued":
+            raise RuntimeError(f"submission rejected: {reply}")
+        self.job_id = reply["job_id"]
+        return self.job_id
+
+    def status(self) -> dict:
+        if self.job_id is None:
+            raise RuntimeError("job not submitted")
+        return self._rpc({"action": "status", "job_id": self.job_id})
+
+    def wait(self, timeout: float = 300.0, poll: float = 0.2) -> dict:
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = self.status()
+            if st["status"] in ("finished", "failed", "timeout"):
+                return st
+            time.sleep(poll)
+        raise TimeoutError(f"job {self.job_id} still {st['status']}")

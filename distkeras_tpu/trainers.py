@@ -70,6 +70,9 @@ class Trainer:
         num_epoch: int = 1,
         seed: int = 0,
         compute_dtype: Any = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1,
+        resume: bool = False,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -85,6 +88,9 @@ class Trainer:
 
             compute_dtype = jnp.dtype(compute_dtype)
         self.compute_dtype = compute_dtype
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self.resume = resume
         self.history: dict = {}
         self.training_time: float = 0.0
         self._t0: Optional[float] = None
@@ -143,12 +149,26 @@ class Trainer:
         )
         window = rule.communication_window if rule.communication_window > 0 else None
         rng = np.random.default_rng(self.seed)
-        state = engine.init_state(jax.random.key(self.seed), feats[: self.batch_size])
+        state = engine.init_state(jax.random.PRNGKey(self.seed), feats[: self.batch_size])
+
+        ckpt = None
+        start_epoch = 0
+        if self.checkpoint_dir:
+            from distkeras_tpu.checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(self.checkpoint_dir, every=self.checkpoint_every)
+            if self.resume and ckpt.latest() is not None:
+                state = ckpt.restore(like=state)
+                start_epoch = int(np.asarray(state.epoch))
+
+        # keep the host RNG stream aligned with the epoch counter on resume
+        for _ in range(start_epoch):
+            rng.permutation(len(feats))
 
         losses_per_epoch: List[float] = []
         metrics_per_epoch: List[np.ndarray] = []
         self.record_training_start()
-        for _ in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             if window is None:
                 # single window spanning the whole epoch (no commits)
                 from distkeras_tpu.data import plan_epoch
@@ -170,6 +190,8 @@ class Trainer:
             m = np.asarray(stats["metrics"])
             if m.size:
                 metrics_per_epoch.append(np.mean(m, axis=0))
+            if ckpt is not None:
+                ckpt.maybe_save(state, epoch)
         if average_at_end:
             state, _ = engine.average_workers(state)
         self.record_training_stop()
@@ -276,10 +298,14 @@ class DistributedTrainer(Trainer):
         seed: int = 0,
         compute_dtype: Any = None,
         commit_schedule: Optional[Sequence[int]] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1,
+        resume: bool = False,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
+            checkpoint_dir, checkpoint_every, resume,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port

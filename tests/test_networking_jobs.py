@@ -1,0 +1,92 @@
+"""Networking codec + job-deployment daemon tests."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.job_deployment import Job, PunchcardServer
+from distkeras_tpu.networking import (
+    _decode,
+    _encode,
+    determine_host_address,
+    recv_data,
+    send_data,
+)
+
+
+def test_codec_roundtrip_scalars_and_arrays():
+    msg = {
+        "action": "commit",
+        "window": 5,
+        "weights": [np.arange(6, dtype=np.float32).reshape(2, 3), np.ones(4)],
+        "nested": {"flag": True, "none": None, "blob": b"\x00\x01"},
+    }
+    out = _decode(_encode(msg))
+    assert out["action"] == "commit" and out["window"] == 5
+    np.testing.assert_array_equal(out["weights"][0], msg["weights"][0])
+    np.testing.assert_array_equal(out["weights"][1], msg["weights"][1])
+    assert out["nested"]["flag"] is True and out["nested"]["none"] is None
+    assert out["nested"]["blob"] == b"\x00\x01"
+
+
+def test_send_recv_over_socket():
+    server = socket.socket()
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+    port = server.getsockname()[1]
+    received = {}
+
+    def serve():
+        conn, _ = server.accept()
+        received["msg"] = recv_data(conn)
+        send_data(conn, {"ok": 1})
+        conn.close()
+
+    t = threading.Thread(target=serve)
+    t.start()
+    client = socket.create_connection(("127.0.0.1", port))
+    send_data(client, {"hello": np.zeros(3)})
+    reply = recv_data(client)
+    t.join(timeout=5)
+    server.close()
+    client.close()
+    assert reply == {"ok": 1}
+    np.testing.assert_array_equal(received["msg"]["hello"], np.zeros(3))
+
+
+def test_determine_host_address_returns_ip():
+    addr = determine_host_address()
+    assert isinstance(addr, str) and addr.count(".") == 3
+
+
+@pytest.fixture()
+def punchcard():
+    server = PunchcardServer(port=0, secret="s3cret")
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_job_submit_run_finish(punchcard):
+    job = Job("127.0.0.1", punchcard.port, secret="s3cret",
+              script="print('result:', 6 * 7)")
+    job.submit()
+    st = job.wait(timeout=30)
+    assert st["status"] == "finished"
+    assert "result: 42" in st["output"]
+
+
+def test_job_failure_reported(punchcard):
+    job = Job("127.0.0.1", punchcard.port, secret="s3cret",
+              script="raise SystemExit(3)")
+    job.submit()
+    st = job.wait(timeout=30)
+    assert st["status"] == "failed" and st["returncode"] == 3
+
+
+def test_job_bad_secret_denied(punchcard):
+    job = Job("127.0.0.1", punchcard.port, secret="wrong", script="print(1)")
+    with pytest.raises(RuntimeError):
+        job.submit()
