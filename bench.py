@@ -26,10 +26,8 @@ def main():
     from distkeras_tpu.algorithms import Downpour
     from distkeras_tpu.models import CIFARCNN, FlaxModel
     from distkeras_tpu.parallel.engine import WindowedEngine
-    from distkeras_tpu.parallel.mesh import make_mesh
 
     num_workers = jax.device_count()
-    mesh = make_mesh(num_workers)
     batch = 256          # per-worker batch
     window = 16          # commit window (local steps between collectives)
     n_windows = 8        # windows per timed epoch
@@ -41,7 +39,7 @@ def main():
         loss="categorical_crossentropy",
         worker_optimizer=("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
         rule=Downpour(communication_window=window),
-        mesh=mesh,
+        num_workers=num_workers,
         metrics=(),
         compute_dtype=jax.numpy.bfloat16,
     )

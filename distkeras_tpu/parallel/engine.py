@@ -7,16 +7,19 @@ shipping pickled Workers into executors), the worker training loop
 loop (``distkeras/parameter_servers.py :: SocketParameterServer.run``).
 
 Design (SURVEY.md §7):
-  * a worker = one position on the ``workers`` mesh axis; its local model
-    replica, optimizer state, and rule state are sharded along that axis;
-  * the parameter-server center variable is *replicated* across the axis;
-  * one epoch is a single jitted ``shard_map`` program: ``lax.scan`` over
-    commit windows, an inner ``lax.scan`` over local optimizer steps, and the
-    rule's ``commit`` (a ``psum`` over ICI + replicated center update) at each
-    window boundary — the TCP pull/commit round-trip of the reference becomes
-    one XLA collective per window;
+  * a *worker* is a logical training replica.  Workers tile onto hardware as
+    ``num_workers = n_devices x virtual_per_device``: the device dimension is
+    a ``shard_map`` over the ``workers`` mesh axis, the virtual dimension a
+    ``vmap`` with its own collective axis name — the TPU form of the
+    reference running more Spark tasks than machines;
+  * the parameter-server center variable is *replicated* across the mesh;
+  * one epoch is a single jitted program: ``lax.scan`` over commit windows,
+    an inner ``lax.scan`` over local optimizer steps, and the rule's
+    ``commit`` — a ``psum`` over ``(vmap axis, mesh axis)`` + replicated
+    center update — at each window boundary.  The reference's per-window TCP
+    pull/commit round-trip becomes one XLA collective over ICI;
   * asynchrony is *modeled*: the staleness-simulation mode gives each worker
-    its own commit schedule (per-step masked commits), reproducing parameter-
+    its own commit period (per-step masked commits), reproducing parameter-
     server race semantics deterministically (SURVEY.md §7 "hard parts").
 
 Everything is static-shaped and trace-once; there is no per-step Python.
@@ -24,9 +27,7 @@ Everything is static-shaped and trace-once; there is no per-step Python.
 
 from __future__ import annotations
 
-import dataclasses
-from functools import partial
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -34,15 +35,28 @@ import numpy as np
 import optax
 from flax import struct
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from distkeras_tpu.algorithms.base import CommitCtx, UpdateRule
 from distkeras_tpu.models.adapter import ModelAdapter
 from distkeras_tpu.ops import get_loss, get_metric, get_optimizer
-from distkeras_tpu.parallel.mesh import replicated_sharding, worker_sharding
+from distkeras_tpu.parallel.mesh import make_mesh, replicated_sharding, worker_sharding
 from distkeras_tpu.utils.pytree import tree_cast, tree_where
 
-__all__ = ["TrainState", "WindowedEngine"]
+__all__ = ["TrainState", "WindowedEngine", "plan_workers"]
+
+VWORKER_AXIS = "vworkers"
+
+
+def plan_workers(num_workers: int, n_devices: int) -> tuple[int, int]:
+    """Tile ``num_workers`` logical workers onto hardware: returns
+    ``(devices_used, virtual_per_device)`` with ``d * v == num_workers``,
+    maximising the device dimension (collectives over ICI beat vmap serial
+    execution whenever chips are available)."""
+    d = min(num_workers, n_devices)
+    while num_workers % d:
+        d -= 1
+    return d, num_workers // d
 
 
 @struct.dataclass
@@ -60,15 +74,6 @@ class TrainState:
     epoch: jnp.ndarray  # replicated scalar
 
 
-def _strip(tree):
-    """Drop the per-worker leading axis inside shard_map blocks."""
-    return jax.tree.map(lambda x: x[0], tree)
-
-
-def _unstrip(tree):
-    return jax.tree.map(lambda x: x[None], tree)
-
-
 class WindowedEngine:
     """Builds and owns the jitted epoch functions for one (model, rule) pair."""
 
@@ -78,30 +83,39 @@ class WindowedEngine:
         loss,
         worker_optimizer,
         rule: UpdateRule,
-        mesh: Mesh,
+        num_workers: Optional[int] = None,
         *,
         metrics: Sequence = ("accuracy",),
         compute_dtype: Optional[Any] = None,
         commit_schedule: Optional[np.ndarray] = None,
         sync_model_state: bool = True,
+        mesh=None,
     ):
         self.adapter = adapter
         self.rule = rule
-        self.mesh = mesh
-        self.axis = mesh.axis_names[0]
-        self.num_workers = mesh.devices.size
+        n_devices = jax.device_count() if mesh is None else mesh.devices.size
+        self.num_workers = num_workers or n_devices
+        self.n_dev, self.virtual = plan_workers(self.num_workers, n_devices)
+        self.mesh = mesh if (mesh is not None and mesh.devices.size == self.n_dev) else make_mesh(self.n_dev)
+        self.axis = self.mesh.axis_names[0]
+        self.both_axes = (VWORKER_AXIS, self.axis)
         self.optimizer = get_optimizer(worker_optimizer)
         self.loss_fn = get_loss(loss, from_logits=adapter.outputs_logits)
         self.metric_fns = [get_metric(m) for m in metrics]
         self.compute_dtype = compute_dtype
         self.sync_model_state = sync_model_state
-        # Per-worker commit schedule (staleness simulation).  None => uniform
+        # Per-worker commit periods (staleness simulation).  None => uniform
         # synchronous windows, one collective per window.
         self.commit_schedule = (
             None if commit_schedule is None else np.asarray(commit_schedule, np.int32)
         )
-        self._rep = replicated_sharding(mesh)
-        self._shard = worker_sharding(mesh)
+        if self.commit_schedule is not None and len(self.commit_schedule) != self.num_workers:
+            raise ValueError(
+                f"commit_schedule has {len(self.commit_schedule)} entries for "
+                f"{self.num_workers} workers"
+            )
+        self._rep = replicated_sharding(self.mesh)
+        self._shard = worker_sharding(self.mesh)
         self._epoch_fns = {}
 
     # ------------------------------------------------------------------ init
@@ -171,6 +185,17 @@ class WindowedEngine:
         params = optax.apply_updates(params, updates)
         return (params, opt_state, model_state, rng), (loss, mets)
 
+    def _make_ctx(self, mask, steps_in_window) -> CommitCtx:
+        """Commit context whose psum totals over BOTH the vmap (virtual
+        worker) axis and the mesh (device) axis."""
+        psum = lambda t: jax.tree.map(lambda v: lax.psum(v, self.both_axes), t)
+        return CommitCtx(
+            psum=psum,
+            mask=jnp.asarray(mask),
+            steps_in_window=jnp.asarray(steps_in_window, jnp.float32),
+            num_workers=self.num_workers,
+        )
+
     def _sync_model_state(self, ctx: CommitCtx, model_state):
         if not self.sync_model_state or not jax.tree.leaves(model_state):
             return model_state
@@ -179,40 +204,54 @@ class WindowedEngine:
 
     # ------------------------------------------------------- epoch (windowed)
     def _make_epoch_fn(self, n_windows: int, window: int, do_commit: bool):
-        axis = self.axis
         rule = self.rule
 
+        def per_worker_window(center_params, center_rule, local, wdata):
+            """One worker's window: inner scan of local steps, then commit.
+            Runs under vmap(axis_name=VWORKER_AXIS) inside shard_map."""
+            local_params, opt_state, model_state, rule_local, rng = local
+            (local_params, opt_state, model_state, rng), (losses, mets) = lax.scan(
+                self._local_step, (local_params, opt_state, model_state, rng), wdata
+            )
+            if do_commit:
+                ctx = self._make_ctx(True, float(window))
+                res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
+                local_params, center_params = res.local_params, res.center_params
+                rule_local, center_rule = res.local_state, res.center_state
+                model_state = self._sync_model_state(ctx, model_state)
+            loss_mean = lax.psum(jnp.mean(losses), self.both_axes) / self.num_workers
+            mets_mean = lax.psum(jnp.mean(mets, axis=0), self.both_axes) / self.num_workers
+            local = (local_params, opt_state, model_state, rule_local, rng)
+            return center_params, center_rule, local, loss_mean, mets_mean
+
+        vmapped = jax.vmap(
+            per_worker_window,
+            in_axes=(None, None, 0, 0),
+            out_axes=(0, 0, 0, None, None),
+            axis_name=VWORKER_AXIS,
+        )
+
         def worker_fn(center_params, center_rule, local, data):
-            local_params, opt_state, model_state, rule_local, rng = _strip(local)
-            xs, ys = _strip(data)
-            psum = lambda t: jax.tree.map(lambda v: lax.psum(v, axis), t)
+            # block shapes: local leaves [v, ...]; data [v, n_windows, window, batch, ...]
+            xs, ys = data
+            xs = jnp.moveaxis(xs, 1, 0)  # scan over windows
+            ys = jnp.moveaxis(ys, 1, 0)
 
             def window_body(carry, wdata):
-                center_params, center_rule, local_params, opt_state, model_state, rule_local, rng = carry
-                (local_params, opt_state, model_state, rng), (losses, mets) = lax.scan(
-                    self._local_step, (local_params, opt_state, model_state, rng), wdata
+                center_params, center_rule, local = carry
+                centers_p, centers_r, local, loss, mets = vmapped(
+                    center_params, center_rule, local, wdata
                 )
-                if do_commit:
-                    ctx = CommitCtx(
-                        psum=psum,
-                        mask=jnp.asarray(True),
-                        steps_in_window=jnp.asarray(float(window)),
-                        num_workers=self.num_workers,
-                    )
-                    res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
-                    local_params, center_params = res.local_params, res.center_params
-                    rule_local, center_rule = res.local_state, res.center_state
-                    model_state = self._sync_model_state(ctx, model_state)
-                loss_mean = lax.psum(jnp.mean(losses), axis) / self.num_workers
-                mets_mean = lax.psum(jnp.mean(mets, axis=0), axis) / self.num_workers
-                carry = (center_params, center_rule, local_params, opt_state, model_state, rule_local, rng)
-                return carry, (loss_mean, mets_mean)
+                # psum over both axes makes every virtual worker's center
+                # identical; collapse the vmap dim.
+                center_params = jax.tree.map(lambda x: x[0], centers_p)
+                center_rule = jax.tree.map(lambda x: x[0], centers_r)
+                return (center_params, center_rule, local), (loss, mets)
 
-            carry = (center_params, center_rule, local_params, opt_state, model_state, rule_local, rng)
-            carry, (losses, mets) = lax.scan(window_body, carry, (xs, ys))
-            center_params, center_rule, local_params, opt_state, model_state, rule_local, rng = carry
-            local_out = _unstrip((local_params, opt_state, model_state, rule_local, rng))
-            return center_params, center_rule, local_out, losses, mets
+            (center_params, center_rule, local), (losses, mets) = lax.scan(
+                window_body, (center_params, center_rule, local), (xs, ys)
+            )
+            return center_params, center_rule, local, losses, mets
 
         mapped = jax.shard_map(
             worker_fn,
@@ -223,11 +262,12 @@ class WindowedEngine:
         )
 
         def epoch_fn(state: TrainState, xs, ys):
-            local = (state.local_params, state.opt_state, state.model_state, state.rule_local, state.rng)
-            center_params, center_rule, local_out, losses, mets = mapped(
+            local = (state.local_params, state.opt_state, state.model_state,
+                     state.rule_local, state.rng)
+            center_params, center_rule, local, losses, mets = mapped(
                 state.center_params, state.center_rule, local, (xs, ys)
             )
-            local_params, opt_state, model_state, rule_local, rng = local_out
+            local_params, opt_state, model_state, rule_local, rng = local
             new_state = TrainState(
                 center_params=center_params,
                 center_rule=center_rule,
@@ -244,49 +284,57 @@ class WindowedEngine:
 
     # ---------------------------------------------- epoch (staleness-sim mode)
     def _make_stepwise_epoch_fn(self, n_steps: int):
-        """Per-step masked commits with a per-worker schedule: the faithful
-        deterministic model of parameter-server asynchrony."""
-        axis = self.axis
+        """Per-step masked commits with a per-worker commit period: the
+        faithful deterministic model of parameter-server asynchrony."""
         rule = self.rule
-        schedule = jnp.asarray(self.commit_schedule, jnp.int32)  # [num_workers]
 
-        def worker_fn(center_params, center_rule, local, data, my_window):
-            local_params, opt_state, model_state, rule_local, rng = _strip(local)
-            xs, ys = _strip(data)
-            w = my_window.reshape(())  # this worker's commit period
-            psum = lambda t: jax.tree.map(lambda v: lax.psum(v, axis), t)
+        def per_worker_step(center_params, center_rule, local, since, batch, t, my_window):
+            local_params, opt_state, model_state, rule_local, rng = local
+            (local_params, opt_state, model_state, rng), (loss, _) = self._local_step(
+                (local_params, opt_state, model_state, rng), batch
+            )
+            since = since + 1
+            mask = (t + 1) % my_window == 0
+            ctx = self._make_ctx(mask, 1.0)
+            ctx = ctx._replace(steps_in_window=since.astype(jnp.float32))
+            res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
+            local_params, center_params = res.local_params, res.center_params
+            rule_local, center_rule = res.local_state, res.center_state
+            model_state = self._sync_model_state(ctx, model_state)
+            since = jnp.where(mask, 0, since)
+            loss_mean = lax.psum(loss, self.both_axes) / self.num_workers
+            local = (local_params, opt_state, model_state, rule_local, rng)
+            return center_params, center_rule, local, since, loss_mean
+
+        vmapped = jax.vmap(
+            per_worker_step,
+            in_axes=(None, None, 0, 0, 0, None, 0),
+            out_axes=(0, 0, 0, 0, None),
+            axis_name=VWORKER_AXIS,
+        )
+
+        def worker_fn(center_params, center_rule, local, data, schedule):
+            xs, ys = data  # [v, n_steps, batch, ...]
+            xs = jnp.moveaxis(xs, 1, 0)
+            ys = jnp.moveaxis(ys, 1, 0)
+            schedule = schedule.reshape(-1)  # [v]
 
             def step_body(carry, inp):
                 t, batch = inp
-                center_params, center_rule, local_params, opt_state, model_state, rule_local, rng, since = carry
-                (local_params, opt_state, model_state, rng), (loss, mets) = self._local_step(
-                    (local_params, opt_state, model_state, rng), batch
+                center_params, center_rule, local, since = carry
+                centers_p, centers_r, local, since, loss = vmapped(
+                    center_params, center_rule, local, since, batch, t, schedule
                 )
-                since = since + 1
-                mask = (t + 1) % w == 0
-                ctx = CommitCtx(
-                    psum=psum,
-                    mask=mask,
-                    steps_in_window=since.astype(jnp.float32),
-                    num_workers=self.num_workers,
-                )
-                res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
-                local_params, center_params = res.local_params, res.center_params
-                rule_local, center_rule = res.local_state, res.center_state
-                model_state = self._sync_model_state(ctx, model_state)
-                since = jnp.where(mask, 0, since)
-                loss_mean = lax.psum(loss, axis) / self.num_workers
-                carry = (center_params, center_rule, local_params, opt_state, model_state, rule_local, rng, since)
-                return carry, loss_mean
+                center_params = jax.tree.map(lambda x: x[0], centers_p)
+                center_rule = jax.tree.map(lambda x: x[0], centers_r)
+                return (center_params, center_rule, local, since), loss
 
-            carry = (
-                center_params, center_rule, local_params, opt_state, model_state,
-                rule_local, rng, jnp.zeros((), jnp.int32),
+            since0 = jnp.zeros((schedule.shape[0],), jnp.int32)
+            (center_params, center_rule, local, _), losses = lax.scan(
+                step_body, (center_params, center_rule, local, since0),
+                (jnp.arange(n_steps), (xs, ys)),
             )
-            carry, losses = lax.scan(step_body, carry, (jnp.arange(n_steps), (xs, ys)))
-            center_params, center_rule, local_params, opt_state, model_state, rule_local, rng, _ = carry
-            local_out = _unstrip((local_params, opt_state, model_state, rule_local, rng))
-            return center_params, center_rule, local_out, losses
+            return center_params, center_rule, local, losses
 
         mapped = jax.shard_map(
             worker_fn,
@@ -296,12 +344,15 @@ class WindowedEngine:
             check_vma=False,
         )
 
+        schedule_arr = jnp.asarray(self.commit_schedule, jnp.int32)
+
         def epoch_fn(state: TrainState, xs, ys):
-            local = (state.local_params, state.opt_state, state.model_state, state.rule_local, state.rng)
-            center_params, center_rule, local_out, losses = mapped(
-                state.center_params, state.center_rule, local, (xs, ys), schedule
+            local = (state.local_params, state.opt_state, state.model_state,
+                     state.rule_local, state.rng)
+            center_params, center_rule, local, losses = mapped(
+                state.center_params, state.center_rule, local, (xs, ys), schedule_arr
             )
-            local_params, opt_state, model_state, rule_local, rng = local_out
+            local_params, opt_state, model_state, rule_local, rng = local
             new_state = TrainState(
                 center_params=center_params,
                 center_rule=center_rule,
@@ -334,7 +385,7 @@ class WindowedEngine:
         with self.mesh:
             return self._epoch_fns[key](state, xs, ys)
 
-    def average_workers(self, state: TrainState) -> TrainState:
+    def average_workers(self, state: TrainState):
         """One-shot synchronous weight average (AveragingTrainer's final step)."""
 
         def _avg(state):
@@ -343,10 +394,7 @@ class WindowedEngine:
             return state.replace(center_params=mean_p), mean_ms
 
         with self.mesh:
-            new_state, mean_ms = jax.jit(
-                _avg,
-                out_shardings=(None, self._rep),
-            )(state)
+            new_state, mean_ms = jax.jit(_avg, out_shardings=(None, self._rep))(state)
         return new_state, mean_ms
 
     def final_model_state(self, state: TrainState):

@@ -31,7 +31,6 @@ from distkeras_tpu.data import epoch_arrays
 from distkeras_tpu.frame import DataFrame
 from distkeras_tpu.models.adapter import ModelAdapter, TrainedModel, as_adapter
 from distkeras_tpu.parallel.engine import WindowedEngine
-from distkeras_tpu.parallel.mesh import make_mesh
 from distkeras_tpu.parameter_servers import (
     ADAGParameterServer,
     DeltaParameterServer,
@@ -136,13 +135,12 @@ class Trainer:
     ):
         adapter = as_adapter(self.master_model)
         feats, labels = self._load_columns(dataframe)
-        mesh = make_mesh(num_workers)
         engine = WindowedEngine(
             adapter,
             self.loss,
             self.worker_optimizer,
             rule,
-            mesh,
+            num_workers,
             metrics=self.metrics,
             compute_dtype=self.compute_dtype,
             commit_schedule=commit_schedule,
