@@ -72,6 +72,7 @@ class Trainer:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
         resume: bool = False,
+        profile_dir: Optional[str] = None,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -90,6 +91,9 @@ class Trainer:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self.resume = resume
+        # SURVEY.md §5.1: the reference only wall-clocked training; we add
+        # optional per-epoch device tracing viewable in TensorBoard/Perfetto.
+        self.profile_dir = profile_dir
         self.history: dict = {}
         self.training_time: float = 0.0
         self._t0: Optional[float] = None
@@ -183,7 +187,14 @@ class Trainer:
                     rng=rng if shuffle else None,
                 )
             xs, ys = engine.shard_batches(xs, ys)
-            state, stats = engine.run_epoch(state, xs, ys)
+            # Trace the second epoch (the first includes compilation), or the
+            # only epoch when there is just one.
+            if self.profile_dir and epoch == min(start_epoch + 1, self.num_epoch - 1):
+                with jax.profiler.trace(self.profile_dir):
+                    state, stats = engine.run_epoch(state, xs, ys)
+                    jax.block_until_ready(state.center_params)
+            else:
+                state, stats = engine.run_epoch(state, xs, ys)
             losses_per_epoch.append(float(np.mean(np.asarray(stats["loss"]))))
             m = np.asarray(stats["metrics"])
             if m.size:
@@ -299,11 +310,12 @@ class DistributedTrainer(Trainer):
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
         resume: bool = False,
+        profile_dir: Optional[str] = None,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
-            checkpoint_dir, checkpoint_every, resume,
+            checkpoint_dir, checkpoint_every, resume, profile_dir,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port
