@@ -51,10 +51,12 @@ def epoch_arrays(
     n_windows, total = plan_epoch(n, num_workers, batch_size, window)
     reps = -(-total // n)
     idx = np.tile(idx, reps)[:total]
-    # Interleave so each worker sees a contiguous stream (like a partition)
-    # but batches are drawn round-robin across the shuffled index.
-    xs = features[idx]
-    ys = labels[idx]
+    # Gather is the host-side hot path: multithreaded native kernel when the
+    # C++ library is available, bit-identical numpy fallback otherwise.
+    from distkeras_tpu import native
+
+    xs = native.gather_rows(features, idx)
+    ys = native.gather_rows(labels, idx)
     if stepwise:
         shape = (num_workers, n_windows * window, batch_size)
     else:

@@ -1,0 +1,108 @@
+"""Native (C++) data-path kernels, loaded via ctypes with a numpy fallback.
+
+The library is compiled on first import (g++, one translation unit, ~1s) into
+a per-user cache directory; if no toolchain is available every entry point
+falls back to numpy transparently, so the package stays pure-Python-portable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["gather_rows", "shuffle_indices", "available"]
+
+_SRC = os.path.join(os.path.dirname(__file__), "dataloader.cpp")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _cache_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")),
+        "distkeras_tpu",
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    return os.path.join(cache_dir, f"libdkdata_{digest}.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DISTKERAS_TPU_NO_NATIVE"):
+        return None
+    path = _cache_path()
+    if not os.path.exists(path):
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                tmp = os.path.join(td, "libdkdata.so")
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-o", tmp, _SRC, "-lpthread"],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, path)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.dk_gather_rows.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.dk_shuffle_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64,
+        ]
+        lib.dk_version.restype = ctypes.c_int
+        assert lib.dk_version() == 1
+        _lib = lib
+    except (OSError, AssertionError):
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray, n_threads: Optional[int] = None) -> np.ndarray:
+    """dst[i] = src[idx[i]] — multithreaded native gather, numpy fallback."""
+    lib = _load()
+    src = np.ascontiguousarray(src)
+    if lib is None:
+        return src[idx]
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.dtype.itemsize
+    if n_threads is None:
+        n_threads = min(8, os.cpu_count() or 1)
+    lib.dk_gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(ctypes.c_void_p),
+        len(idx), row_bytes, n_threads,
+    )
+    return out
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    """Deterministic native Fisher-Yates permutation of arange(n)."""
+    idx = np.arange(n, dtype=np.int64)
+    lib = _load()
+    if lib is None:
+        np.random.default_rng(seed).shuffle(idx)
+        return idx
+    lib.dk_shuffle_indices(
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, seed & (2**64 - 1)
+    )
+    return idx

@@ -1,0 +1,78 @@
+// Native data-path kernels for distkeras_tpu.
+//
+// The reference's per-row Python iterators (distkeras/workers.py minibatch
+// loop) have no native analogue; here the host-side hot path is epoch
+// batching — permutation-gather of the full feature matrix into the
+// [workers, windows, window, batch, ...] layout (distkeras_tpu/data.py).
+// numpy's fancy indexing is single-threaded; for CIFAR-scale epochs this
+// multithreaded gather is the difference between the TPU waiting on the host
+// and not.
+//
+// Built as a plain shared library (no pybind11 — loaded via ctypes):
+//   g++ -O3 -march=native -shared -fPIC -o libdkdata.so dataloader.cpp -lpthread
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Parallel row gather: dst[i] = src[idx[i]] for rows of row_bytes bytes.
+void gather_rows_impl(const uint8_t* src, const int64_t* idx, uint8_t* dst,
+                      int64_t n_rows, int64_t row_bytes, int n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int64_t> next{0};
+  const int64_t chunk = 256;
+  auto work = [&] {
+    for (;;) {
+      int64_t start = next.fetch_add(chunk);
+      if (start >= n_rows) return;
+      int64_t end = start + chunk < n_rows ? start + chunk : n_rows;
+      for (int64_t i = start; i < end; ++i) {
+        std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+      }
+    }
+  };
+  if (n_threads == 1) {
+    work();
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(work);
+  for (auto& t : threads) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather rows by index. src/dst are raw buffers; row_bytes = bytes per row.
+void dk_gather_rows(const void* src, const int64_t* idx, void* dst,
+                    int64_t n_rows, int64_t row_bytes, int n_threads) {
+  gather_rows_impl(static_cast<const uint8_t*>(src), idx,
+                   static_cast<uint8_t*>(dst), n_rows, row_bytes, n_threads);
+}
+
+// Fisher-Yates shuffle of an index array with SplitMix64 (deterministic for a
+// given seed — keeps the framework's reproducibility guarantee native-side).
+void dk_shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
+  auto splitmix = [&seed]() {
+    uint64_t z = (seed += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  };
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = static_cast<int64_t>(splitmix() % static_cast<uint64_t>(i + 1));
+    int64_t tmp = idx[i];
+    idx[i] = idx[j];
+    idx[j] = tmp;
+  }
+}
+
+int dk_version() { return 1; }
+
+}  // extern "C"
