@@ -1,0 +1,131 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+The reference has no long-context story (SURVEY.md §5.7: MLP/CNN-scale models
+only); this module is the TPU-native extension that makes sequence length a
+shardable dimension, so the framework scales to contexts that do not fit one
+chip's HBM.
+
+Design (Liu et al., "Ring Attention with Blockwise Transformers", 2023 —
+re-derived here for ``shard_map``): the sequence axis is sharded over a mesh
+axis; every device holds one Q/K/V block.  K/V blocks rotate around the ring
+with ``lax.ppermute`` (neighbour hops over ICI) while each device accumulates
+its Q block's attention with a numerically-stable online softmax
+(flash-attention-style running max / denominator).  Compute for block *t*
+overlaps the transfer of block *t+1* — XLA overlaps the collective-permute
+with the matmuls, so the ring latency hides behind the FLOPs.
+
+No step materialises the full [seq, seq] score matrix, and per-device memory
+is O(block² + block·d) instead of O(seq²).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "local_attention", "ring_attention_sharded"]
+
+
+def _block_attention(q, k, v, carry, block_mask):
+    """One online-softmax accumulation step.
+
+    q: [b, h, lq, d]; k/v: [b, h, lk, d];
+    carry = (num [b,h,lq,d], den [b,h,lq], m [b,h,lq]);
+    block_mask: [lq, lk] additive mask (0 or -inf) or None.
+    """
+    num, den, m = carry
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if block_mask is not None:
+        s = s + block_mask
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows: exp(-inf - -inf) -> exp(0); zero them via p
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    num = num * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    den = den * alpha + p.sum(axis=-1)
+    return num, den, m_new
+
+
+def local_attention(q, k, v, causal: bool = False):
+    """Reference (single-device) attention with the same layout
+    ([batch, seq, heads, dim]); used by tests and the non-sharded fallback."""
+    qt = jnp.moveaxis(q, 1, 2)  # [b,h,l,d]
+    kt = jnp.moveaxis(k, 1, 2)
+    vt = jnp.moveaxis(v, 1, 2)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vt)
+    return jnp.moveaxis(out, 1, 2)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Blockwise ring attention inside ``shard_map``.
+
+    Args: per-device blocks [batch, block_len, heads, dim] with the sequence
+    axis sharded over ``axis_name``.  Returns the attention output for this
+    device's Q block, exactly equal (up to float assoc.) to full attention
+    over the gathered sequence.
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    qt = jnp.moveaxis(q, 1, 2)  # [b,h,lq,d]
+    kt = jnp.moveaxis(k, 1, 2)
+    vt = jnp.moveaxis(v, 1, 2)
+    b, h, lq, d = qt.shape
+    lk = kt.shape[2]
+
+    neg = jnp.asarray(-jnp.inf, qt.dtype)
+    num0 = jnp.zeros((b, h, lq, d), qt.dtype)
+    den0 = jnp.zeros((b, h, lq), qt.dtype)
+    m0 = jnp.full((b, h, lq), neg, qt.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    tri = jnp.tril(jnp.ones((lq, lk), bool)) if causal else None
+
+    def body(t, state):
+        kt_cur, vt_cur, carry = state
+        # kv currently held originated at device (my_idx - t) mod n
+        src = (my_idx - t) % n
+        if causal:
+            # block-level causal structure: full attend when src < my block,
+            # diagonal causal mask when src == my block, skip when src > mine.
+            diag = jnp.where(tri, 0.0, -jnp.inf).astype(qt.dtype)
+            block_mask = jnp.where(
+                src == my_idx, diag, jnp.where(src < my_idx, 0.0, -jnp.inf)
+            ).astype(qt.dtype)
+        else:
+            block_mask = None
+        carry = _block_attention(qt, kt_cur, vt_cur, carry, block_mask)
+        kt_nxt = lax.ppermute(kt_cur, axis_name, perm)
+        vt_nxt = lax.ppermute(vt_cur, axis_name, perm)
+        return kt_nxt, vt_nxt, carry
+
+    _, _, (num, den, m) = lax.fori_loop(0, n, body, (kt, vt, (num0, den0, m0)))
+    out = num / jnp.where(den == 0, 1.0, den)[..., None]
+    return jnp.moveaxis(out, 1, 2)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: Optional[str] = None,
+                           causal: bool = False):
+    """Convenience wrapper: global [batch, seq, heads, dim] arrays, sequence
+    axis sharded over ``axis_name``; runs :func:`ring_attention` under
+    ``shard_map``."""
+    axis_name = axis_name or mesh.axis_names[0]
+    spec = P(None, axis_name)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

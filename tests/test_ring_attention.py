@@ -1,0 +1,54 @@
+"""Ring attention vs full attention: exact agreement on a sharded mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.parallel.mesh import make_mesh
+from distkeras_tpu.parallel.ring import (
+    local_attention,
+    ring_attention_sharded,
+)
+
+
+def _qkv(batch=2, seq=64, heads=4, dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (batch, seq, heads, dim)
+    return tuple(rng.normal(size=shape).astype(np.float32) for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(causal):
+    q, k, v = _qkv()
+    mesh = make_mesh(4)
+    expected = np.asarray(local_attention(jnp.asarray(q), jnp.asarray(k),
+                                          jnp.asarray(v), causal=causal))
+    got = np.asarray(ring_attention_sharded(q, k, v, mesh, causal=causal))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_matches_with_eight_shards():
+    q, k, v = _qkv(seq=128, seed=3)
+    mesh = make_mesh(8)
+    expected = np.asarray(local_attention(jnp.asarray(q), jnp.asarray(k),
+                                          jnp.asarray(v), causal=True))
+    got = np.asarray(ring_attention_sharded(q, k, v, mesh, causal=True))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_under_jit_and_grad():
+    """Ring attention must be differentiable (it sits inside training steps)."""
+    q, k, v = _qkv(batch=1, seq=32, heads=2, dim=8)
+    mesh = make_mesh(4)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(local_attention(q, k, v) ** 2)
+
+    g_ring = jax.grad(loss_ring)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_full = jax.grad(loss_full)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_full),
+                               rtol=5e-4, atol=5e-5)
