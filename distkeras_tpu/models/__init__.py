@@ -7,6 +7,7 @@ from distkeras_tpu.models.adapter import (
     TrainedModel,
     as_adapter,
 )
+from distkeras_tpu.models.transformer import TransformerClassifier, TransformerEncoderBlock
 from distkeras_tpu.models.zoo import CIFARCNN, MLP, MNISTCNN, ResNet20, TextCNN
 
 __all__ = [
@@ -20,4 +21,6 @@ __all__ = [
     "CIFARCNN",
     "ResNet20",
     "TextCNN",
+    "TransformerClassifier",
+    "TransformerEncoderBlock",
 ]

@@ -1,0 +1,110 @@
+"""Transformer models with optional sequence parallelism.
+
+Beyond the reference's model scale (SURVEY.md §5.7): a Transformer encoder
+classifier whose sequence axis can be sharded over a mesh axis.  When
+``seq_axis`` is set (running inside ``shard_map`` with that axis), attention
+runs as ring attention (:mod:`distkeras_tpu.parallel.ring`) and the classifier
+head pools *per-token logits* so every parameter-consuming op sees sharded
+activations — which makes the cross-shard gradient sync a plain ``psum`` over
+the sequence axis (done by the engine), with no replicated-activation
+double-counting.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.parallel.ring import local_attention, ring_attention
+
+__all__ = ["TransformerClassifier", "TransformerEncoderBlock"]
+
+
+class _SelfAttention(nn.Module):
+    dim: int
+    heads: int
+    seq_axis: Optional[str] = None
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        head_dim = self.dim // self.heads
+        qkv = nn.DenseGeneral((3, self.heads, head_dim), name="qkv")(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        if self.seq_axis is not None:
+            out = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
+        else:
+            out = local_attention(q, k, v, causal=self.causal)
+        return nn.DenseGeneral(self.dim, axis=(-2, -1), name="proj")(out)
+
+
+class TransformerEncoderBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    seq_axis: Optional[str] = None
+    causal: bool = False
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        h = nn.LayerNorm()(x)
+        h = _SelfAttention(self.dim, self.heads, self.seq_axis, self.causal)(h, training)
+        if self.dropout > 0:
+            h = nn.Dropout(self.dropout, deterministic=not training)(h)
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.dim * self.mlp_ratio)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim)(h)
+        if self.dropout > 0:
+            h = nn.Dropout(self.dropout, deterministic=not training)(h)
+        return x + h
+
+
+class TransformerClassifier(nn.Module):
+    """Token classifier over [batch, seq(block)] int32 inputs.
+
+    With ``seq_axis`` set, the input is this device's sequence *block*;
+    positional embeddings are offset by the block index and the head output
+    is psum-pooled over the axis (replicated logits out).
+    """
+
+    vocab_size: int
+    num_classes: int = 2
+    dim: int = 128
+    heads: int = 4
+    num_layers: int = 2
+    max_len: int = 2048
+    seq_axis: Optional[str] = None
+    causal: bool = False
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, training: bool = False):
+        tokens = tokens.astype(jnp.int32)
+        block_len = tokens.shape[1]
+        if self.seq_axis is not None:
+            offset = lax.axis_index(self.seq_axis) * block_len
+            seq_total = block_len * lax.axis_size(self.seq_axis)
+        else:
+            offset = 0
+            seq_total = block_len
+        positions = offset + jnp.arange(block_len)
+        x = nn.Embed(self.vocab_size, self.dim, name="tok_embed")(tokens)
+        x = x + nn.Embed(self.max_len, self.dim, name="pos_embed")(positions)[None]
+        for i in range(self.num_layers):
+            x = TransformerEncoderBlock(
+                self.dim, self.heads, seq_axis=self.seq_axis, causal=self.causal,
+                dropout=self.dropout, name=f"block_{i}",
+            )(x, training)
+        x = nn.LayerNorm()(x)
+        token_logits = nn.Dense(self.num_classes, name="head")(x)  # [b, blk, C]
+        logits = token_logits.sum(axis=1) / seq_total
+        if self.seq_axis is not None:
+            logits = lax.psum(logits, self.seq_axis)
+        return logits

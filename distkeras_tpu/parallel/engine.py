@@ -40,7 +40,13 @@ from jax.sharding import PartitionSpec as P
 from distkeras_tpu.algorithms.base import CommitCtx, UpdateRule
 from distkeras_tpu.models.adapter import ModelAdapter
 from distkeras_tpu.ops import get_loss, get_metric, get_optimizer
-from distkeras_tpu.parallel.mesh import make_mesh, replicated_sharding, worker_sharding
+from distkeras_tpu.parallel.mesh import (
+    SEQ_AXIS,
+    make_mesh,
+    make_mesh_grid,
+    replicated_sharding,
+    worker_sharding,
+)
 from distkeras_tpu.utils.pytree import tree_cast, tree_where
 
 __all__ = ["TrainState", "WindowedEngine", "plan_workers"]
@@ -90,13 +96,30 @@ class WindowedEngine:
         commit_schedule: Optional[np.ndarray] = None,
         sync_model_state: bool = True,
         mesh=None,
+        seq_shards: int = 1,
     ):
         self.adapter = adapter
         self.rule = rule
+        self.seq_shards = int(seq_shards)
         n_devices = jax.device_count() if mesh is None else mesh.devices.size
-        self.num_workers = num_workers or n_devices
-        self.n_dev, self.virtual = plan_workers(self.num_workers, n_devices)
-        self.mesh = mesh if (mesh is not None and mesh.devices.size == self.n_dev) else make_mesh(self.n_dev)
+        if self.seq_shards > 1:
+            # combined data x sequence parallelism: 2-D mesh, worker state on
+            # axis 0, sequence blocks on axis 1 (requires a seq-axis-aware
+            # model, e.g. TransformerClassifier(seq_axis='seq'))
+            worker_devices = n_devices // self.seq_shards
+            self.num_workers = num_workers or worker_devices
+            self.n_dev, self.virtual = plan_workers(self.num_workers, worker_devices)
+            self.mesh = make_mesh_grid(self.n_dev, self.seq_shards)
+            self.seq_axis = SEQ_AXIS
+        else:
+            self.num_workers = num_workers or n_devices
+            self.n_dev, self.virtual = plan_workers(self.num_workers, n_devices)
+            self.mesh = (
+                mesh
+                if (mesh is not None and mesh.devices.size == self.n_dev)
+                else make_mesh(self.n_dev)
+            )
+            self.seq_axis = None
         self.axis = self.mesh.axis_names[0]
         self.both_axes = (VWORKER_AXIS, self.axis)
         self.optimizer = get_optimizer(worker_optimizer)
@@ -120,7 +143,18 @@ class WindowedEngine:
 
     # ------------------------------------------------------------------ init
     def init_state(self, rng: jax.Array, sample_input) -> TrainState:
-        params, model_state = self.adapter.init(rng, sample_input)
+        if self.seq_axis is not None:
+            # seq-axis-aware models use lax.axis_index/psum during their
+            # forward pass, so even init must run inside the mesh program,
+            # with the sample's sequence (last) axis sharded.
+            sample = jnp.asarray(sample_input)
+            spec = P(*([None] * (sample.ndim - 1)), self.seq_axis)
+            params, model_state = jax.shard_map(
+                lambda s: self.adapter.init(rng, s),
+                mesh=self.mesh, in_specs=(spec,), out_specs=P(), check_vma=False,
+            )(sample)
+        else:
+            params, model_state = self.adapter.init(rng, sample_input)
         n = self.num_workers
 
         def _build(params, model_state):
@@ -181,6 +215,15 @@ class WindowedEngine:
         (loss, (model_state, mets)), grads = jax.value_and_grad(compute_loss, has_aux=True)(
             params, model_state
         )
+        if self.seq_axis is not None:
+            # Sequence-parallel gradient sync.  Each shard's backward pass
+            # yields seq_shards x (its partial gradient): the loss is computed
+            # replicated on every shard and psum's transpose inside shard_map
+            # is itself a psum, so every replica's cotangent lands on each
+            # shard.  pmean over the axis = psum(partials)/shards = the exact
+            # total gradient (verified against the unsharded model in
+            # tests/test_sequence_parallel.py).
+            grads = jax.tree.map(lambda g: lax.pmean(g, self.seq_axis), grads)
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return (params, opt_state, model_state, rng), (loss, mets)
@@ -202,8 +245,17 @@ class WindowedEngine:
         mean = jax.tree.map(lambda x: ctx.psum(x) / self.num_workers, model_state)
         return tree_where(ctx.mask, mean, model_state)
 
+    def _data_specs(self, xs_ndim: int):
+        """Partition specs for (xs, ys): worker axis leading; for sequence
+        parallelism the sequence (last) axis of xs also shards."""
+        if self.seq_axis is not None:
+            xs_spec = P(self.axis, *([None] * (xs_ndim - 2)), self.seq_axis)
+        else:
+            xs_spec = P(self.axis)
+        return xs_spec, P(self.axis)
+
     # ------------------------------------------------------- epoch (windowed)
-    def _make_epoch_fn(self, n_windows: int, window: int, do_commit: bool):
+    def _make_epoch_fn(self, n_windows: int, window: int, do_commit: bool, xs_ndim: int = 5):
         rule = self.rule
 
         def per_worker_window(center_params, center_rule, local, wdata):
@@ -231,9 +283,8 @@ class WindowedEngine:
             axis_name=VWORKER_AXIS,
         )
 
-        def worker_fn(center_params, center_rule, local, data):
-            # block shapes: local leaves [v, ...]; data [v, n_windows, window, batch, ...]
-            xs, ys = data
+        def worker_fn(center_params, center_rule, local, xs, ys):
+            # block shapes: local leaves [v, ...]; xs [v, n_windows, window, batch, ...]
             xs = jnp.moveaxis(xs, 1, 0)  # scan over windows
             ys = jnp.moveaxis(ys, 1, 0)
 
@@ -253,10 +304,11 @@ class WindowedEngine:
             )
             return center_params, center_rule, local, losses, mets
 
+        xs_spec, ys_spec = self._data_specs(xs_ndim)
         mapped = jax.shard_map(
             worker_fn,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(self.axis), P(self.axis)),
+            in_specs=(P(), P(), P(self.axis), xs_spec, ys_spec),
             out_specs=(P(), P(), P(self.axis), P(), P()),
             check_vma=False,
         )
@@ -265,7 +317,7 @@ class WindowedEngine:
             local = (state.local_params, state.opt_state, state.model_state,
                      state.rule_local, state.rng)
             center_params, center_rule, local, losses, mets = mapped(
-                state.center_params, state.center_rule, local, (xs, ys)
+                state.center_params, state.center_rule, local, xs, ys
             )
             local_params, opt_state, model_state, rule_local, rng = local
             new_state = TrainState(
@@ -283,7 +335,7 @@ class WindowedEngine:
         return jax.jit(epoch_fn, donate_argnums=(0,))
 
     # ---------------------------------------------- epoch (staleness-sim mode)
-    def _make_stepwise_epoch_fn(self, n_steps: int):
+    def _make_stepwise_epoch_fn(self, n_steps: int, xs_ndim: int = 4):
         """Per-step masked commits with a per-worker commit period: the
         faithful deterministic model of parameter-server asynchrony."""
         rule = self.rule
@@ -313,8 +365,8 @@ class WindowedEngine:
             axis_name=VWORKER_AXIS,
         )
 
-        def worker_fn(center_params, center_rule, local, data, schedule):
-            xs, ys = data  # [v, n_steps, batch, ...]
+        def worker_fn(center_params, center_rule, local, xs, ys, schedule):
+            # xs: [v, n_steps, batch, ...]
             xs = jnp.moveaxis(xs, 1, 0)
             ys = jnp.moveaxis(ys, 1, 0)
             schedule = schedule.reshape(-1)  # [v]
@@ -336,10 +388,11 @@ class WindowedEngine:
             )
             return center_params, center_rule, local, losses
 
+        xs_spec, ys_spec = self._data_specs(xs_ndim)
         mapped = jax.shard_map(
             worker_fn,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(self.axis), P(self.axis), P(self.axis)),
+            in_specs=(P(), P(), P(self.axis), xs_spec, ys_spec, P(self.axis)),
             out_specs=(P(), P(), P(self.axis), P()),
             check_vma=False,
         )
@@ -350,7 +403,7 @@ class WindowedEngine:
             local = (state.local_params, state.opt_state, state.model_state,
                      state.rule_local, state.rng)
             center_params, center_rule, local, losses = mapped(
-                state.center_params, state.center_rule, local, (xs, ys), schedule_arr
+                state.center_params, state.center_rule, local, xs, ys, schedule_arr
             )
             local_params, opt_state, model_state, rule_local, rng = local
             new_state = TrainState(
@@ -373,15 +426,15 @@ class WindowedEngine:
         window, batch] (uniform mode) or [num_workers, n_steps, batch]
         (staleness mode)."""
         if self.commit_schedule is not None:
-            key = ("step", xs.shape[1])
+            key = ("step", xs.shape[1], xs.ndim)
             if key not in self._epoch_fns:
-                self._epoch_fns[key] = self._make_stepwise_epoch_fn(xs.shape[1])
+                self._epoch_fns[key] = self._make_stepwise_epoch_fn(xs.shape[1], xs.ndim)
         else:
             n_windows, window = xs.shape[1], xs.shape[2]
             do_commit = self.rule.communication_window > 0
-            key = ("win", n_windows, window, do_commit)
+            key = ("win", n_windows, window, do_commit, xs.ndim)
             if key not in self._epoch_fns:
-                self._epoch_fns[key] = self._make_epoch_fn(n_windows, window, do_commit)
+                self._epoch_fns[key] = self._make_epoch_fn(n_windows, window, do_commit, xs.ndim)
         with self.mesh:
             return self._epoch_fns[key](state, xs, ys)
 
@@ -411,9 +464,13 @@ class WindowedEngine:
 
     # --------------------------------------------------------------- sharding
     def shard_batches(self, xs: np.ndarray, ys: np.ndarray):
-        """Device-put epoch data with the per-worker sharding."""
+        """Device-put epoch data: worker axis leading; sequence (last) axis of
+        xs also sharded when sequence parallelism is on."""
+        from jax.sharding import NamedSharding
+
+        xs_spec, ys_spec = self._data_specs(xs.ndim)
         with self.mesh:
             return (
-                jax.device_put(xs, self._shard),
-                jax.device_put(ys, self._shard),
+                jax.device_put(xs, NamedSharding(self.mesh, xs_spec)),
+                jax.device_put(ys, NamedSharding(self.mesh, ys_spec)),
             )

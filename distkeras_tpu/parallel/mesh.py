@@ -18,13 +18,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "WORKER_AXIS",
+    "SEQ_AXIS",
     "make_mesh",
+    "make_mesh_grid",
     "worker_sharding",
     "replicated_sharding",
     "local_device_count",
 ]
 
 WORKER_AXIS = "workers"
+SEQ_AXIS = "seq"
 
 
 def local_device_count() -> int:
@@ -53,6 +56,26 @@ def make_mesh(
             "On CPU, set XLA_FLAGS=--xla_force_host_platform_device_count=N."
         )
     return Mesh(np.array(devices[:num_workers]), (axis_name,))
+
+
+def make_mesh_grid(
+    num_worker_devices: int,
+    seq_shards: int,
+    axis_names: tuple = (WORKER_AXIS, SEQ_AXIS),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """2-D mesh for combined data x sequence parallelism: worker-local state
+    shards over the first axis, long sequences over the second (ring
+    attention's neighbour hops ride ICI)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_worker_devices * seq_shards
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {num_worker_devices}x{seq_shards} needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(num_worker_devices, seq_shards)
+    return Mesh(grid, axis_names)
 
 
 def worker_sharding(mesh: Mesh) -> NamedSharding:

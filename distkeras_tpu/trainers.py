@@ -73,6 +73,7 @@ class Trainer:
         checkpoint_every: int = 1,
         resume: bool = False,
         profile_dir: Optional[str] = None,
+        seq_shards: int = 1,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -94,6 +95,9 @@ class Trainer:
         # SURVEY.md §5.1: the reference only wall-clocked training; we add
         # optional per-epoch device tracing viewable in TensorBoard/Perfetto.
         self.profile_dir = profile_dir
+        # sequence parallelism (ring attention) shards: >1 requires a
+        # seq-axis-aware model (models/transformer.py)
+        self.seq_shards = int(seq_shards)
         self.history: dict = {}
         self.training_time: float = 0.0
         self._t0: Optional[float] = None
@@ -148,6 +152,7 @@ class Trainer:
             metrics=self.metrics,
             compute_dtype=self.compute_dtype,
             commit_schedule=commit_schedule,
+            seq_shards=self.seq_shards,
         )
         window = rule.communication_window if rule.communication_window > 0 else None
         rng = np.random.default_rng(self.seed)
@@ -311,11 +316,12 @@ class DistributedTrainer(Trainer):
         checkpoint_every: int = 1,
         resume: bool = False,
         profile_dir: Optional[str] = None,
+        seq_shards: int = 1,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
-            checkpoint_dir, checkpoint_every, resume, profile_dir,
+            checkpoint_dir, checkpoint_every, resume, profile_dir, seq_shards,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port

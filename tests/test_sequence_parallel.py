@@ -1,0 +1,90 @@
+"""Combined data x sequence parallelism: Transformer training on a 2-D mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.models import FlaxModel, TransformerClassifier
+
+
+def toy_text(n=256, seq=32, vocab=50, seed=0):
+    """Class = whether token id 7 appears more than id 3 (needs attention over
+    the whole sequence)."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, vocab, size=(n, seq)).astype(np.int32)
+    y = ((x == 7).sum(1) > (x == 3).sum(1)).astype(np.int32)
+    onehot = np.eye(2, dtype=np.float32)[y]
+    return x, y, onehot
+
+
+def _model(seq_axis=None):
+    return FlaxModel(TransformerClassifier(
+        vocab_size=50, num_classes=2, dim=32, heads=2, num_layers=1,
+        max_len=64, seq_axis=seq_axis,
+    ))
+
+
+def test_sp_forward_matches_unsharded():
+    """Same params, same input: 2-way sequence-sharded forward == local."""
+    from distkeras_tpu.parallel.engine import WindowedEngine
+    from distkeras_tpu.algorithms import Downpour
+
+    x, _, onehot = toy_text(n=8)
+    sp = WindowedEngine(_model("seq"), "categorical_crossentropy", "sgd",
+                        Downpour(2), num_workers=2, seq_shards=2)
+    state = sp.init_state(jax.random.PRNGKey(0), x[:4])
+
+    params = jax.tree.map(np.asarray, state.center_params)
+    local_adapter = _model(None)
+    out_local, _ = local_adapter.apply(params, {}, jnp.asarray(x[:4]))
+
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    sp_adapter = _model("seq")
+    out_sp = _jax.shard_map(
+        lambda xx: sp_adapter.apply(params, {}, xx)[0],
+        mesh=sp.mesh, in_specs=(P(None, "seq"),), out_specs=P(),
+        check_vma=False,
+    )(jnp.asarray(x[:4]))
+    np.testing.assert_allclose(np.asarray(out_sp), np.asarray(out_local),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_downpour_with_sequence_parallelism_converges():
+    x, y, onehot = toy_text()
+    df = from_numpy(x, onehot)
+    t = dk.DOWNPOUR(_model("seq"), loss="categorical_crossentropy",
+                    worker_optimizer=("adam", {"learning_rate": 3e-3}),
+                    num_workers=4, batch_size=16, num_epoch=15,
+                    communication_window=2, seq_shards=2)
+    trained = t.train(df)
+    # predict path: model is seq-axis-aware, so score through the engine mesh
+    h = t.get_history()["loss"]
+    assert h[-1] < h[0] * 0.7  # loss dropped substantially
+    assert t.num_updates > 0
+
+
+def test_sp_matches_dp_only_training():
+    """4 workers x 2 seq shards must give (numerically) the same training
+    trajectory as 4 workers unsharded — sequence parallelism is an
+    implementation detail, not a semantics change."""
+    x, _, onehot = toy_text(n=128)
+    df = from_numpy(x, onehot)
+
+    def run(seq_shards, seq_axis):
+        t = dk.DOWNPOUR(_model(seq_axis), loss="categorical_crossentropy",
+                        worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                        num_workers=4, batch_size=8, num_epoch=2,
+                        communication_window=2, seq_shards=seq_shards, seed=5)
+        trained = t.train(df)
+        return trained.params
+
+    p_dp = run(1, None)
+    p_sp = run(2, "seq")
+    for a, b in zip(jax.tree.leaves(p_dp), jax.tree.leaves(p_sp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
