@@ -1,18 +1,19 @@
-"""Headline benchmark: CIFAR-10 CNN DOWNPOUR throughput (samples/sec/chip).
+"""Benchmark harness for the BASELINE.json configs.
 
-This is the `BASELINE.json` metric ("CIFAR-10 CNN samples/sec/chip").  The
-reference published no machine-readable numbers (`published: {}` — see
-BASELINE.md), so `vs_baseline` is reported against the pinned value in
-`bench_baseline.json` (first recorded run of this benchmark on a v5e chip);
->1.0 means faster than that pin.
-
-Prints exactly one JSON line:
+Default (no args): the headline metric — CIFAR-10 CNN DOWNPOUR
+samples/sec/chip — printed as exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+``--config <name>`` runs one of the five reference benchmark configs
+(BASELINE.md table); ``--config all`` runs everything (one JSON line each).
+``vs_baseline`` compares against the pinned first-run numbers in
+``bench_baseline.json`` (the reference itself published no machine-readable
+numbers — ``BASELINE.json .published == {}``); >1.0 means faster than the pin.
 """
 
+import argparse
 import json
 import os
-import sys
 import time
 
 import numpy as np
@@ -20,42 +21,83 @@ import numpy as np
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
 
 
-def main():
+def _engine_for(config):
     import jax
 
-    from distkeras_tpu.algorithms import Downpour
-    from distkeras_tpu.models import CIFARCNN, FlaxModel
+    from distkeras_tpu.algorithms import Adag, Aeasgd, Downpour, DynSGD, Sequential
+    from distkeras_tpu.models import (
+        CIFARCNN,
+        MLP,
+        MNISTCNN,
+        FlaxModel,
+        ResNet20,
+        TextCNN,
+    )
     from distkeras_tpu.parallel.engine import WindowedEngine
 
-    num_workers = jax.device_count()
-    batch = 256          # per-worker batch
-    window = 16          # commit window (local steps between collectives)
-    n_windows = 8        # windows per timed epoch
-    steps = n_windows * window
-
-    adapter = FlaxModel(CIFARCNN())
+    n = jax.device_count()
+    bf16 = jax.numpy.bfloat16
+    # (adapter, rule, worker_opt, batch, window, data_shape, int_data, classes)
+    table = {
+        "cifar_cnn_downpour": (
+            FlaxModel(CIFARCNN()), Downpour(16),
+            ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+            256, 16, (32, 32, 3), False, 10, bf16,
+        ),
+        "mnist_mlp_single": (
+            FlaxModel(MLP()), Sequential(),
+            ("sgd", {"learning_rate": 0.1}),
+            512, 32, (784,), False, 10, bf16,
+        ),
+        "mnist_cnn_downpour": (
+            FlaxModel(MNISTCNN()), Downpour(16),
+            ("sgd", {"learning_rate": 0.05}),
+            256, 16, (28, 28, 1), False, 10, bf16,
+        ),
+        "cifar_cnn_aeasgd": (
+            FlaxModel(CIFARCNN()), Aeasgd(communication_window=16, rho=5.0, learning_rate=0.05),
+            ("sgd", {"learning_rate": 0.05}),
+            256, 16, (32, 32, 3), False, 10, bf16,
+        ),
+        "cifar_resnet20_adag": (
+            FlaxModel(ResNet20()), Adag(16),
+            ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+            128, 16, (32, 32, 3), False, 10, bf16,
+        ),
+        "imdb_textcnn_dynsgd": (
+            FlaxModel(TextCNN(vocab_size=20000, num_classes=2)), DynSGD(16),
+            ("adam", {"learning_rate": 1e-3}),
+            128, 16, (256,), True, 2, bf16,
+        ),
+    }
+    adapter, rule, opt, batch, window, shape, int_data, classes, dtype = table[config]
+    num_workers = n
     engine = WindowedEngine(
-        adapter,
-        loss="categorical_crossentropy",
-        worker_optimizer=("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
-        rule=Downpour(communication_window=window),
-        num_workers=num_workers,
-        metrics=(),
-        compute_dtype=jax.numpy.bfloat16,
+        adapter, "categorical_crossentropy", opt, rule,
+        num_workers=num_workers, metrics=(), compute_dtype=dtype,
     )
+    return engine, batch, window, shape, int_data, classes
 
+
+def run_config(config: str, n_windows: int = 8, reps: int = 3) -> dict:
+    import jax
+
+    engine, batch, window, shape, int_data, classes = _engine_for(config)
+    num_workers = engine.num_workers
+    steps = n_windows * window
     rng = np.random.default_rng(0)
-    xs = rng.normal(size=(num_workers, n_windows, window, batch, 32, 32, 3)).astype(np.float32)
-    ys = rng.integers(0, 10, size=(num_workers, n_windows, window, batch)).astype(np.int32)
+    full = (num_workers, n_windows, window, batch) + shape
+    if int_data:
+        xs = rng.integers(0, 1000, size=full).astype(np.int32)
+    else:
+        xs = rng.normal(size=full).astype(np.float32)
+    ys = rng.integers(0, classes, size=(num_workers, n_windows, window, batch)).astype(np.int32)
     state = engine.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
     xs, ys = engine.shard_batches(xs, ys)
 
-    # Warmup: compile + one full epoch.
-    state, _ = engine.run_epoch(state, xs, ys)
+    state, _ = engine.run_epoch(state, xs, ys)  # warmup/compile
     jax.block_until_ready(state.center_params)
 
-    # Timed epochs.
-    reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
         state, stats = engine.run_epoch(state, xs, ys)
@@ -63,21 +105,41 @@ def main():
     dt = time.perf_counter() - t0
 
     samples = reps * num_workers * steps * batch
-    sps_per_chip = samples / dt / num_workers
+    sps_per_chip = samples / dt / jax.device_count()
 
-    vs = 1.0
+    pinned = {}
     if os.path.exists(BASELINE_FILE):
         try:
-            pinned = json.load(open(BASELINE_FILE))["samples_per_sec_per_chip"]
-            vs = sps_per_chip / pinned
+            pinned = json.load(open(BASELINE_FILE)).get("configs", {})
         except Exception:
-            pass
-    print(json.dumps({
-        "metric": "cifar10_cnn_downpour_samples_per_sec_per_chip",
+            pinned = {}
+    vs = sps_per_chip / pinned[config] if config in pinned else 1.0
+    return {
+        "metric": f"{config}_samples_per_sec_per_chip",
         "value": round(sps_per_chip, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs, 3),
-    }))
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="cifar_cnn_downpour",
+                        choices=["cifar_cnn_downpour", "mnist_mlp_single",
+                                 "mnist_cnn_downpour", "cifar_cnn_aeasgd",
+                                 "cifar_resnet20_adag", "imdb_textcnn_dynsgd", "all"])
+    args = parser.parse_args()
+    configs = (
+        ["cifar_cnn_downpour", "mnist_mlp_single", "mnist_cnn_downpour",
+         "cifar_cnn_aeasgd", "cifar_resnet20_adag", "imdb_textcnn_dynsgd"]
+        if args.config == "all" else [args.config]
+    )
+    for config in configs:
+        result = run_config(config)
+        if config == "cifar_cnn_downpour":
+            # keep the headline metric name stable for the driver
+            result["metric"] = "cifar10_cnn_downpour_samples_per_sec_per_chip"
+        print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -352,11 +352,41 @@ class DistributedTrainer(Trainer):
     def num_updates(self) -> int:
         return self.parameter_server.num_updates if self.parameter_server else 0
 
+    def train_with_recovery(self, dataframe: DataFrame, shuffle: bool = False,
+                            max_retries: int = 2):
+        """Failure-tolerant training (SURVEY.md §5.3).
+
+        The reference leaned on Spark task retries (a retried worker
+        reconnects to the PS and keeps training); a JAX SPMD program instead
+        fails as a unit, so the recovery unit is the epoch: on an exception
+        the trainer reloads the latest checkpoint and resumes.  Requires
+        ``checkpoint_dir``; each retry restarts from the last completed
+        checkpointed epoch (bit-exact — see test_checkpoint).
+        """
+        if not self.checkpoint_dir:
+            raise ValueError("train_with_recovery requires checkpoint_dir")
+        attempts = 0
+        while True:
+            try:
+                return self.train(dataframe, shuffle)
+            except Exception:
+                attempts += 1
+                if attempts > max_retries:
+                    raise
+                self.resume = True  # pick up from the latest checkpoint
+
+    @property
+    def _logical_workers(self) -> int:
+        """Logical worker count; AsynchronousDistributedTrainer multiplies by
+        ``parallelism_factor`` (the reference's Spark over-partitioning),
+        realised here as virtual workers per device."""
+        return self.num_workers * getattr(self, "parallelism_factor", 1)
+
     def train(self, dataframe: DataFrame, shuffle: bool = False):
         worker = self.allocate_worker()
         self.service()
         engine, state, adapter = self._fit(
-            dataframe, worker.rule, self.num_workers, shuffle=shuffle,
+            dataframe, worker.rule, self._logical_workers, shuffle=shuffle,
             commit_schedule=self.commit_schedule,
         )
         self.parameter_server.attach(

@@ -77,3 +77,20 @@ def test_tiling_invariance_of_center(toy_classification):
     tiled = run(make_mesh(2))    # 2 devices x 4 virtual
     for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(tiled)):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_parallelism_factor_overpartitions(toy_classification):
+    """Reference parity: parallelism_factor multiplies logical workers."""
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    t = dk.DOWNPOUR(FlaxModel(MLP(features=(8,), num_classes=2)),
+                    loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=4, parallelism_factor=3, batch_size=8,
+                    num_epoch=4, communication_window=2)
+    trained = t.train(df)
+    preds = trained.predict(x)
+    acc = float(np.mean(np.argmax(preds, -1) == y))
+    assert acc > 0.8
+    # 12 logical workers all commit: update counter is a multiple of 12
+    assert t.num_updates % 12 == 0 and t.num_updates > 0
