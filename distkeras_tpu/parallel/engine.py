@@ -465,12 +465,20 @@ class WindowedEngine:
     # --------------------------------------------------------------- sharding
     def shard_batches(self, xs: np.ndarray, ys: np.ndarray):
         """Device-put epoch data: worker axis leading; sequence (last) axis of
-        xs also sharded when sequence parallelism is on."""
+        xs also sharded when sequence parallelism is on.
+
+        Uses ``make_array_from_callback`` so the same code works multi-host
+        (each process materialises only its addressable shards — the DCN
+        analogue of Spark shipping partitions to executors)."""
         from jax.sharding import NamedSharding
 
         xs_spec, ys_spec = self._data_specs(xs.ndim)
         with self.mesh:
             return (
-                jax.device_put(xs, NamedSharding(self.mesh, xs_spec)),
-                jax.device_put(ys, NamedSharding(self.mesh, ys_spec)),
+                jax.make_array_from_callback(
+                    xs.shape, NamedSharding(self.mesh, xs_spec), lambda idx: xs[idx]
+                ),
+                jax.make_array_from_callback(
+                    ys.shape, NamedSharding(self.mesh, ys_spec), lambda idx: ys[idx]
+                ),
             )

@@ -1,0 +1,59 @@
+"""Worker script for the multi-host integration test.
+
+Launched as N separate processes by test_multihost.py; each joins the
+jax.distributed coordination service (the reference's master host:port
+handshake), contributes 4 faked CPU devices, and runs DOWNPOUR over the
+global 8-device mesh — commits ride the cross-process collective path (the
+DCN analogue).
+"""
+
+import sys
+
+
+def main(coordinator: str, num_processes: int, process_id: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.device_count() == 4 * num_processes, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    import numpy as np
+
+    from distkeras_tpu.algorithms import Downpour
+    from distkeras_tpu.models import MLP, FlaxModel
+    from distkeras_tpu.parallel.engine import WindowedEngine
+
+    engine = WindowedEngine(
+        FlaxModel(MLP(features=(16,), num_classes=2)),
+        "categorical_crossentropy",
+        ("sgd", {"learning_rate": 0.1}),
+        Downpour(communication_window=2),
+        num_workers=jax.device_count(),
+    )
+    rng = np.random.default_rng(0)  # same data on every process (SPMD)
+    x = rng.normal(size=(512, 8)).astype(np.float32)
+    y = (x @ rng.normal(size=(8,)) > 0).astype(np.int32)
+    onehot = np.eye(2, dtype=np.float32)[y]
+    xs = x.reshape(8, 2, 2, 16, 8)
+    ys = onehot.reshape(8, 2, 2, 16, 2)
+
+    state = engine.init_state(jax.random.PRNGKey(0), x[:16])
+    xs_d, ys_d = engine.shard_batches(xs, ys)
+    losses = []
+    for _ in range(6):
+        state, stats = engine.run_epoch(state, xs_d, ys_d)
+        losses.append(float(np.mean(np.asarray(stats["loss"]))))
+    assert losses[-1] < losses[0], losses
+    assert int(np.asarray(state.center_rule["num_updates"])) == 8 * 2 * 6
+    print(f"process {process_id}: ok, losses {losses[0]:.3f}->{losses[-1]:.3f}")
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

@@ -1,0 +1,106 @@
+"""Unit tests for the ops registries and epoch batching."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distkeras_tpu.data import epoch_arrays, plan_epoch
+from distkeras_tpu.ops import accuracy, get_loss, get_metric, get_optimizer
+
+
+# -- losses ----------------------------------------------------------------
+
+def test_categorical_crossentropy_logits_vs_probs():
+    logits = jnp.asarray([[2.0, 0.0], [0.0, 2.0]])
+    labels = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+    l_logits = get_loss("categorical_crossentropy", from_logits=True)(logits, labels)
+    probs = jax.nn.softmax(logits) if (jax := __import__("jax")) else None
+    l_probs = get_loss("categorical_crossentropy", from_logits=False)(probs, labels)
+    np.testing.assert_allclose(float(l_logits), float(l_probs), rtol=1e-5)
+
+
+def test_crossentropy_accepts_class_indices():
+    logits = jnp.asarray([[5.0, 0.0], [0.0, 5.0]])
+    l_idx = get_loss("categorical_crossentropy")(logits, jnp.asarray([0, 1]))
+    l_oh = get_loss("categorical_crossentropy")(logits, jnp.eye(2))
+    np.testing.assert_allclose(float(l_idx), float(l_oh), rtol=1e-6)
+
+
+def test_mse_and_mae():
+    p = jnp.asarray([[1.0], [3.0]])
+    y = jnp.asarray([[0.0], [1.0]])
+    assert float(get_loss("mse")(p, y)) == pytest.approx(2.5)
+    assert float(get_loss("mae")(p, y)) == pytest.approx(1.5)
+
+
+def test_binary_crossentropy_perfect_prediction_near_zero():
+    p = jnp.asarray([[0.999], [0.001]])
+    y = jnp.asarray([[1.0], [0.0]])
+    assert float(get_loss("binary_crossentropy", from_logits=False)(p, y)) < 0.01
+
+
+def test_unknown_loss_raises():
+    with pytest.raises(ValueError):
+        get_loss("nope")
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_accuracy_forms():
+    preds = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+    assert float(accuracy(preds, jnp.asarray([0, 1]))) == 1.0
+    assert float(accuracy(preds, jnp.eye(2))) == 1.0
+    assert float(get_metric("accuracy")(preds, jnp.asarray([1, 1]))) == 0.5
+
+
+# -- optimizers ------------------------------------------------------------
+
+def test_optimizer_specs():
+    assert isinstance(get_optimizer("sgd"), optax.GradientTransformation)
+    assert isinstance(get_optimizer(("adam", {"learning_rate": 1e-2})), optax.GradientTransformation)
+    tx = optax.sgd(0.1)
+    assert get_optimizer(tx) is tx
+    with pytest.raises(ValueError):
+        get_optimizer("nadamax")
+
+
+# -- epoch batching --------------------------------------------------------
+
+def test_plan_epoch_covers_dataset():
+    n_windows, total = plan_epoch(n=1000, num_workers=4, batch_size=32, window=5)
+    assert total >= 1000
+    assert total == n_windows * 5 * 4 * 32
+
+
+def test_epoch_arrays_shapes_and_coverage():
+    feats = np.arange(100, dtype=np.float32).reshape(100, 1)
+    labels = np.arange(100, dtype=np.int32)
+    xs, ys = epoch_arrays(feats, labels, num_workers=2, batch_size=8, window=3)
+    assert xs.shape[0] == 2 and xs.shape[2] == 3 and xs.shape[3] == 8
+    # wrap-padding: every original sample appears at least once
+    assert set(ys.reshape(-1).tolist()) == set(range(100))
+
+
+def test_epoch_arrays_stepwise_mode():
+    feats = np.zeros((64, 4), np.float32)
+    labels = np.zeros(64, np.int32)
+    xs, ys = epoch_arrays(feats, labels, num_workers=4, batch_size=4, window=2,
+                          stepwise=True)
+    assert xs.ndim == 4  # [workers, steps, batch, features]
+    assert xs.shape[0] == 4 and xs.shape[2] == 4
+
+
+def test_epoch_arrays_shuffle_determinism():
+    feats = np.arange(50, dtype=np.float32).reshape(50, 1)
+    labels = np.arange(50, dtype=np.int32)
+    a = epoch_arrays(feats, labels, 2, 5, 2, rng=np.random.default_rng(3))[1]
+    b = epoch_arrays(feats, labels, 2, 5, 2, rng=np.random.default_rng(3))[1]
+    c = epoch_arrays(feats, labels, 2, 5, 2, rng=np.random.default_rng(4))[1]
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_epoch_arrays_empty_raises():
+    with pytest.raises(ValueError):
+        epoch_arrays(np.zeros((0, 3)), np.zeros(0), 2, 4, 2)
