@@ -1,0 +1,28 @@
+"""Parallel runtime: mesh construction, the SPMD windowed engine, and ring
+attention (sequence parallelism)."""
+
+from distkeras_tpu.parallel.engine import TrainState, WindowedEngine, plan_workers
+from distkeras_tpu.parallel.mesh import (
+    SEQ_AXIS,
+    WORKER_AXIS,
+    make_mesh,
+    make_mesh_grid,
+    replicated_sharding,
+    worker_sharding,
+)
+from distkeras_tpu.parallel.ring import local_attention, ring_attention, ring_attention_sharded
+
+__all__ = [
+    "WindowedEngine",
+    "TrainState",
+    "plan_workers",
+    "make_mesh",
+    "make_mesh_grid",
+    "worker_sharding",
+    "replicated_sharding",
+    "WORKER_AXIS",
+    "SEQ_AXIS",
+    "ring_attention",
+    "ring_attention_sharded",
+    "local_attention",
+]

@@ -224,6 +224,13 @@ class Trainer:
         else:
             params = engine.worker_slice(state.local_params, 0)
         model_state = jax.tree.map(np.asarray, engine.final_model_state(state))
+        # A sequence-parallel model needs a mesh to run; hand back its
+        # single-device twin (same params) so .predict works anywhere.
+        module = getattr(adapter, "module", None)
+        if module is not None and getattr(module, "seq_axis", None) is not None:
+            from distkeras_tpu.models.adapter import FlaxModel
+
+            adapter = FlaxModel(module.clone(seq_axis=None), adapter.outputs_logits)
         if hasattr(adapter, "assign"):  # Keras path: mutate + return the Keras model
             return adapter.assign(params, model_state)
         return TrainedModel(adapter, params, model_state, history=self.history)
@@ -275,16 +282,11 @@ class EnsembleTrainer(Trainer):
             dataframe, worker.rule, self.num_models, shuffle=shuffle
         )
         model_state = jax.tree.map(np.asarray, engine.final_model_state(state))
-        out = []
-        for i in range(self.num_models):
-            params = engine.worker_slice(state.local_params, i)
-            if hasattr(adapter, "assign"):
-                import copy
-
-                out.append(TrainedModel(adapter, params, model_state, history=self.history))
-            else:
-                out.append(TrainedModel(adapter, params, model_state, history=self.history))
-        return out
+        return [
+            TrainedModel(adapter, engine.worker_slice(state.local_params, i),
+                         model_state, history=self.history)
+            for i in range(self.num_models)
+        ]
 
 
 class DistributedTrainer(Trainer):

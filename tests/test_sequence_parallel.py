@@ -88,3 +88,24 @@ def test_sp_matches_dp_only_training():
     for a, b in zip(jax.tree.leaves(p_dp), jax.tree.leaves(p_sp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-3, atol=5e-4)
+
+
+def test_sp_trained_model_predicts_without_mesh():
+    """The returned model must be usable for plain inference (non-SP twin)."""
+    x, y, onehot = toy_text(n=128)
+    df = from_numpy(x, onehot)
+    t = dk.DOWNPOUR(_model("seq"), loss="categorical_crossentropy",
+                    worker_optimizer=("adam", {"learning_rate": 3e-3}),
+                    num_workers=4, batch_size=16, num_epoch=10,
+                    communication_window=2, seq_shards=2)
+    trained = t.train(df)
+    preds = trained.predict(x)
+    assert preds.shape == (128, 2)
+    acc = float(np.mean(np.argmax(preds, -1) == y))
+    assert acc > 0.6
+    # the full predict -> evaluate pipeline also works
+    pred_df = dk.ModelPredictor(trained, features_col="features").predict(df)
+    pred_df = dk.LabelIndexTransformer(2, input_col="prediction",
+                                      output_col="pidx").transform(pred_df)
+    pred_df = pred_df.with_column("y", y)
+    assert dk.AccuracyEvaluator(prediction_col="pidx", label_col="y").evaluate(pred_df) == acc
