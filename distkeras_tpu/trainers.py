@@ -172,8 +172,7 @@ class Trainer:
         for _ in range(start_epoch):
             rng.permutation(len(feats))
 
-        losses_per_epoch: List[float] = []
-        metrics_per_epoch: List[np.ndarray] = []
+        epoch_stats: List[dict] = []
         self.record_training_start()
         for epoch in range(start_epoch, self.num_epoch):
             if window is None:
@@ -200,14 +199,19 @@ class Trainer:
                     jax.block_until_ready(state.center_params)
             else:
                 state, stats = engine.run_epoch(state, xs, ys)
-            losses_per_epoch.append(float(np.mean(np.asarray(stats["loss"]))))
-            m = np.asarray(stats["metrics"])
-            if m.size:
-                metrics_per_epoch.append(np.mean(m, axis=0))
+            # keep stats as device arrays: dispatch is async, so the next
+            # epoch's host-side batching overlaps this epoch's device compute
+            epoch_stats.append(stats)
             if ckpt is not None:
                 ckpt.maybe_save(state, epoch)
         if average_at_end:
             state, _ = engine.average_workers(state)
+
+        losses_per_epoch = [float(np.mean(np.asarray(s["loss"]))) for s in epoch_stats]
+        metrics_per_epoch = [
+            m for m in (np.asarray(s["metrics"]) for s in epoch_stats) if m.size
+        ]
+        metrics_per_epoch = [np.mean(m, axis=0) for m in metrics_per_epoch]
         self.record_training_stop()
 
         self.history = {"loss": losses_per_epoch, "training_time": self.get_training_time()}
