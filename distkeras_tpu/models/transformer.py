@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distkeras_tpu.parallel.ring import local_attention, ring_attention
+from distkeras_tpu.parallel.ring import attention, ring_attention
 
 __all__ = ["TransformerClassifier", "TransformerEncoderBlock"]
 
@@ -38,7 +38,7 @@ class _SelfAttention(nn.Module):
         if self.seq_axis is not None:
             out = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
         else:
-            out = local_attention(q, k, v, causal=self.causal)
+            out = attention(q, k, v, causal=self.causal)
         return nn.DenseGeneral(self.dim, axis=(-2, -1), name="proj")(out)
 
 

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+Kernels auto-fall-back to the Pallas interpreter on non-TPU backends so the
+CPU device-mesh test suite exercises the same code path the TPU runs.
+"""
+
+from distkeras_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -10,7 +10,12 @@ from distkeras_tpu.parallel.mesh import (
     replicated_sharding,
     worker_sharding,
 )
-from distkeras_tpu.parallel.ring import local_attention, ring_attention, ring_attention_sharded
+from distkeras_tpu.parallel.ring import (
+    attention,
+    local_attention,
+    ring_attention,
+    ring_attention_sharded,
+)
 
 __all__ = [
     "WindowedEngine",
@@ -22,6 +27,7 @@ __all__ = [
     "replicated_sharding",
     "WORKER_AXIS",
     "SEQ_AXIS",
+    "attention",
     "ring_attention",
     "ring_attention_sharded",
     "local_attention",

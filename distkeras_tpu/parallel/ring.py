@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ring_attention", "local_attention", "ring_attention_sharded"]
+__all__ = ["ring_attention", "local_attention", "ring_attention_sharded",
+           "attention"]
 
 
 def _block_attention(q, k, v, carry, block_mask):
@@ -66,6 +67,23 @@ def local_attention(q, k, v, causal: bool = False):
         s = jnp.where(mask, s, -jnp.inf)
     out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vt)
     return jnp.moveaxis(out, 1, 2)
+
+
+def attention(q, k, v, causal: bool = False, use_flash: Optional[bool] = None):
+    """Single-device attention dispatcher ([batch, seq, heads, dim]).
+
+    On the TPU backend this routes to the fused Pallas flash kernel
+    (:mod:`distkeras_tpu.ops.pallas`) — tiled online softmax, no [seq, seq]
+    HBM materialisation; elsewhere (CPU test meshes) it uses the jnp
+    reference path, which XLA:CPU handles better than the Pallas interpreter.
+    """
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        from distkeras_tpu.ops.pallas import flash_attention
+
+        return flash_attention(q, k, v, causal)
+    return local_attention(q, k, v, causal=causal)
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):

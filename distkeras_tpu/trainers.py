@@ -199,8 +199,12 @@ class Trainer:
                     jax.block_until_ready(state.center_params)
             else:
                 state, stats = engine.run_epoch(state, xs, ys)
-            # keep stats as device arrays: dispatch is async, so the next
-            # epoch's host-side batching overlaps this epoch's device compute
+            # keep the current epoch's stats as device arrays: dispatch is
+            # async, so the next epoch's host-side batching overlaps this
+            # epoch's device compute.  Materialise the previous epoch's stats
+            # now (its compute is long done) so retention stays O(1).
+            if epoch_stats:
+                epoch_stats[-1] = jax.tree.map(np.asarray, epoch_stats[-1])
             epoch_stats.append(stats)
             if ckpt is not None:
                 ckpt.maybe_save(state, epoch)
