@@ -15,6 +15,8 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from distkeras_tpu.ops.pooling import max_pool
+
 __all__ = ["MLP", "MNISTCNN", "CIFARCNN", "ResNet20", "TextCNN"]
 
 
@@ -46,9 +48,9 @@ class MNISTCNN(nn.Module):
         if x.ndim == 2:  # flat 784 vectors from the DataFrame path
             x = x.reshape((x.shape[0], 28, 28, 1))
         x = nn.relu(nn.Conv(32, (3, 3))(x))
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = max_pool(x, (2, 2), strides=(2, 2))
         x = nn.relu(nn.Conv(64, (3, 3))(x))
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(128)(x))
         return nn.Dense(self.num_classes)(x)
@@ -66,7 +68,7 @@ class CIFARCNN(nn.Module):
         for filters in (64, 128):
             x = nn.relu(nn.Conv(filters, (3, 3))(x))
             x = nn.relu(nn.Conv(filters, (3, 3))(x))
-            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(256)(x))
         return nn.Dense(self.num_classes)(x)

@@ -1,7 +1,11 @@
 """Pallas TPU kernels for the framework's hot ops.
 
-Kernels auto-fall-back to the Pallas interpreter on non-TPU backends so the
-CPU device-mesh test suite exercises the same code path the TPU runs.
+On non-TPU backends the kernels can run under the Pallas interpreter
+(``interpret=True``), which is how ``tests/test_pallas_kernels.py`` validates
+them against the reference jnp attention.  Note the production dispatcher
+(``distkeras_tpu.parallel.ring.attention``) routes non-TPU backends to the
+jnp path, so the CPU device-mesh integration tests do NOT exercise these
+kernels — only the dedicated kernel tests do.
 """
 
 from distkeras_tpu.ops.pallas.flash_attention import flash_attention

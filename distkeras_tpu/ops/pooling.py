@@ -1,0 +1,57 @@
+"""TPU-friendly pooling.
+
+``flax.linen.max_pool`` lowers to ``lax.reduce_window`` whose gradient is an
+XLA ``select-and-scatter`` — profiled at ~11% of the CIFAR-CNN training step
+on TPU v5e (it cannot fuse with the surrounding conv/ReLU fusions).  For the
+overwhelmingly common case — non-overlapping windows, VALID padding, evenly
+divisible spatial dims — an exact reshape-then-reduce formulation lowers to a
+plain ``reduce_max`` whose gradient is an elementwise equality mask that XLA
+fuses into neighbouring kernels.
+
+The reference has no pooling op of its own (all compute is delegated to
+Keras/TF — ``distkeras/workers.py`` just calls ``train_on_batch``); this
+module exists because the rebuild owns its compute path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["max_pool"]
+
+
+def max_pool(
+    x: jnp.ndarray,
+    window_shape: Sequence[int] = (2, 2),
+    strides: Optional[Sequence[int]] = None,
+    padding: str = "VALID",
+) -> jnp.ndarray:
+    """Drop-in replacement for ``flax.linen.max_pool`` (NHWC / NWC layouts).
+
+    Takes the reshape fast path when windows are non-overlapping
+    (``strides == window_shape``), padding is VALID, and every pooled spatial
+    dim divides evenly; falls back to ``flax.linen.max_pool`` otherwise.
+    Forward numerics are identical in every case; the fast path's gradient
+    differs from select-and-scatter only when a window holds exact ties
+    (measure-zero for continuous activations).
+    """
+    window_shape = tuple(window_shape)
+    strides = window_shape if strides is None else tuple(strides)
+    spatial = x.shape[1:-1]  # leading batch, trailing channels
+    if (
+        padding == "VALID"
+        and strides == window_shape
+        and len(spatial) == len(window_shape)
+        and all(s % w == 0 for s, w in zip(spatial, window_shape))
+    ):
+        shape = [x.shape[0]]
+        axes = []
+        for dim, w in zip(spatial, window_shape):
+            shape.extend((dim // w, w))
+            axes.append(len(shape) - 1)
+        shape.append(x.shape[-1])
+        return x.reshape(shape).max(axis=tuple(axes))
+    return nn.max_pool(x, window_shape, strides=strides, padding=padding)

@@ -271,15 +271,18 @@ class WindowedEngine:
                 local_params, center_params = res.local_params, res.center_params
                 rule_local, center_rule = res.local_state, res.center_state
                 model_state = self._sync_model_state(ctx, model_state)
-            loss_mean = lax.psum(jnp.mean(losses), self.both_axes) / self.num_workers
-            mets_mean = lax.psum(jnp.mean(mets, axis=0), self.both_axes) / self.num_workers
+            # Window stats stay worker-local here; one psum at the end of the
+            # epoch reduces them (a per-window collective in the scan body
+            # would serialise every window on the slowest device).
+            loss_mean = jnp.mean(losses)
+            mets_mean = jnp.mean(mets, axis=0)
             local = (local_params, opt_state, model_state, rule_local, rng)
             return center_params, center_rule, local, loss_mean, mets_mean
 
         vmapped = jax.vmap(
             per_worker_window,
             in_axes=(None, None, 0, 0),
-            out_axes=(0, 0, 0, None, None),
+            out_axes=(0, 0, 0, 0, 0),
             axis_name=VWORKER_AXIS,
         )
 
@@ -302,6 +305,10 @@ class WindowedEngine:
             (center_params, center_rule, local), (losses, mets) = lax.scan(
                 window_body, (center_params, center_rule, local), (xs, ys)
             )
+            # losses: [n_windows, v]; mets: [n_windows, v, M].  Single
+            # end-of-epoch reduction over virtual workers + mesh devices.
+            losses = lax.psum(jnp.sum(losses, axis=1), self.axis) / self.num_workers
+            mets = lax.psum(jnp.sum(mets, axis=1), self.axis) / self.num_workers
             return center_params, center_rule, local, losses, mets
 
         xs_spec, ys_spec = self._data_specs(xs_ndim)
@@ -354,14 +361,13 @@ class WindowedEngine:
             rule_local, center_rule = res.local_state, res.center_state
             model_state = self._sync_model_state(ctx, model_state)
             since = jnp.where(mask, 0, since)
-            loss_mean = lax.psum(loss, self.both_axes) / self.num_workers
             local = (local_params, opt_state, model_state, rule_local, rng)
-            return center_params, center_rule, local, since, loss_mean
+            return center_params, center_rule, local, since, loss
 
         vmapped = jax.vmap(
             per_worker_step,
             in_axes=(None, None, 0, 0, 0, None, 0),
-            out_axes=(0, 0, 0, 0, None),
+            out_axes=(0, 0, 0, 0, 0),
             axis_name=VWORKER_AXIS,
         )
 
@@ -386,6 +392,9 @@ class WindowedEngine:
                 step_body, (center_params, center_rule, local, since0),
                 (jnp.arange(n_steps), (xs, ys)),
             )
+            # losses: [n_steps, v] — one end-of-epoch reduction (see the
+            # windowed epoch fn for why this is not done per step).
+            losses = lax.psum(jnp.sum(losses, axis=1), self.axis) / self.num_workers
             return center_params, center_rule, local, losses
 
         xs_spec, ys_spec = self._data_specs(xs_ndim)

@@ -48,8 +48,8 @@ def test_gradients_match_reference(causal):
 
 
 def test_gradients_with_padding():
-    # seq=80 pads to 96 (block 48? no — round_up(80,16)=80, block min(32,80)=32
-    # → pads to 96); padded rows/cols must contribute zero gradient.
+    # seq=80 with block min(32, round_up(80,16))=32 pads to 96; padded
+    # rows/cols must contribute zero gradient.
     q, k, v = _rand_qkv(jax.random.key(2), 1, 80, 1, 16)
 
     def loss(fn):

@@ -1,0 +1,50 @@
+"""ops.pooling: the reshape fast path must match flax.linen.max_pool exactly
+(forward AND gradient), and the fallback must engage for overlapping /
+padded / ragged cases."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.pooling import max_pool
+
+
+def _grad_of(pool_fn, x, **kw):
+    return jax.grad(lambda a: jnp.sum(pool_fn(a, **kw) ** 2))(x)
+
+
+@pytest.mark.parametrize(
+    "shape,window,strides",
+    [
+        ((4, 8, 8, 3), (2, 2), (2, 2)),      # fast path, NHWC
+        ((4, 8, 8, 3), (2, 2), None),         # strides default to window
+        ((2, 12, 6, 5), (3, 2), (3, 2)),      # non-square fast path
+        ((3, 10, 7), (2,), (2,)),             # NWC 1-D fast path (seq models)
+        ((4, 8, 8, 3), (2, 2), (1, 1)),       # overlapping -> fallback
+        ((4, 7, 7, 3), (2, 2), (2, 2)),       # ragged dims -> fallback
+    ],
+)
+def test_matches_flax(shape, window, strides):
+    x = jnp.asarray(np.random.default_rng(0).normal(size=shape), jnp.float32)
+    kw = dict(window_shape=window, strides=strides)
+    ref_kw = dict(window_shape=window, strides=strides or window)
+    np.testing.assert_allclose(max_pool(x, **kw), nn.max_pool(x, **ref_kw))
+    np.testing.assert_allclose(
+        _grad_of(max_pool, x, **kw), _grad_of(nn.max_pool, x, **ref_kw)
+    )
+
+
+def test_same_padding_falls_back():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8, 8, 4)), jnp.float32)
+    got = max_pool(x, (2, 2), strides=(2, 2), padding="SAME")
+    ref = nn.max_pool(x, (2, 2), strides=(2, 2), padding="SAME")
+    np.testing.assert_allclose(got, ref)
+
+
+def test_jit_and_dtype_preserved():
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 4, 4, 8)), jnp.bfloat16)
+    out = jax.jit(max_pool)(x)
+    assert out.dtype == jnp.bfloat16
+    assert out.shape == (2, 2, 2, 8)
