@@ -9,6 +9,7 @@ setup(
     ),
     long_description=open("README.md", encoding="utf-8").read(),
     long_description_content_type="text/markdown",
+    license="MIT",
     packages=find_packages(include=["distkeras_tpu", "distkeras_tpu.*"]),
     python_requires=">=3.10",
     install_requires=[
