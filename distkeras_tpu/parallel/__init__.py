@@ -2,6 +2,7 @@
 attention (sequence parallelism)."""
 
 from distkeras_tpu.parallel.engine import TrainState, WindowedEngine, plan_workers
+from distkeras_tpu.parallel.gspmd import TP_AXIS, GSPMDEngine
 from distkeras_tpu.parallel.mesh import (
     SEQ_AXIS,
     WORKER_AXIS,
@@ -19,6 +20,8 @@ from distkeras_tpu.parallel.ring import (
 
 __all__ = [
     "WindowedEngine",
+    "GSPMDEngine",
+    "TP_AXIS",
     "TrainState",
     "plan_workers",
     "make_mesh",

@@ -254,13 +254,13 @@ class WindowedEngine:
             xs_spec = P(self.axis)
         return xs_spec, P(self.axis)
 
-    # ------------------------------------------------------- epoch (windowed)
-    def _make_epoch_fn(self, n_windows: int, window: int, do_commit: bool, xs_ndim: int = 5):
+    def _window_fn(self, do_commit: bool, window: int):
+        """Build the one-worker window body: inner scan of local steps, then
+        commit.  Runs under ``vmap(axis_name=VWORKER_AXIS)`` — inside
+        ``shard_map`` here, or under plain jit in the GSPMD engine."""
         rule = self.rule
 
         def per_worker_window(center_params, center_rule, local, wdata):
-            """One worker's window: inner scan of local steps, then commit.
-            Runs under vmap(axis_name=VWORKER_AXIS) inside shard_map."""
             local_params, opt_state, model_state, rule_local, rng = local
             (local_params, opt_state, model_state, rng), (losses, mets) = lax.scan(
                 self._local_step, (local_params, opt_state, model_state, rng), wdata
@@ -279,8 +279,12 @@ class WindowedEngine:
             local = (local_params, opt_state, model_state, rule_local, rng)
             return center_params, center_rule, local, loss_mean, mets_mean
 
+        return per_worker_window
+
+    # ------------------------------------------------------- epoch (windowed)
+    def _make_epoch_fn(self, n_windows: int, window: int, do_commit: bool, xs_ndim: int = 5):
         vmapped = jax.vmap(
-            per_worker_window,
+            self._window_fn(do_commit, window),
             in_axes=(None, None, 0, 0),
             out_axes=(0, 0, 0, 0, 0),
             axis_name=VWORKER_AXIS,
@@ -470,6 +474,12 @@ class WindowedEngine:
     def worker_slice(self, tree, index: int):
         """Fetch one worker's slice of per-worker state to host (Ensemble path)."""
         return jax.tree.map(lambda x: np.asarray(x[index]), tree)
+
+    def gather_center(self, state: TrainState):
+        """Center params as host-gatherable (replicated) arrays.  Already
+        replicated in this engine; the GSPMD engine re-replicates its
+        model-axis-sharded leaves here."""
+        return state.center_params
 
     # --------------------------------------------------------------- sharding
     def shard_batches(self, xs: np.ndarray, ys: np.ndarray):

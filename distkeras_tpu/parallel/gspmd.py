@@ -1,0 +1,265 @@
+"""GSPMD engine: windowed async-SGD with compiler-partitioned tensor
+parallelism.
+
+The reference has no tensor parallelism at all (its only strategy is the
+socket-parameter-server data parallelism of ``distkeras/trainers.py`` /
+``distkeras/parameter_servers.py``); SURVEY.md §2 marks TP as the idiomatic
+TPU stretch goal "via pjit param sharding".  This module is that goal: a
+second engine with the *same* windowed commit semantics as
+:class:`~distkeras_tpu.parallel.engine.WindowedEngine`, built the pjit way
+instead of the shard_map way —
+
+  * the mesh is 2-D ``(workers, model)``;
+  * per-worker state carries its leading ``[num_workers]`` axis sharded over
+    ``workers`` (data parallelism), and every large parameter leaf is
+    *additionally* sharded over ``model`` (tensor parallelism) via
+    ``with_sharding_constraint``;
+  * there is no ``shard_map`` and no hand-placed collective for TP: the
+    worker dimension is a ``vmap`` with an axis name (so the commit rules'
+    ``psum`` still means "sum over workers"), and XLA's SPMD partitioner
+    inserts the all-gathers/reduce-scatters implied by the param shardings.
+
+Because partitioning is sharding-propagation rather than hand-written
+collectives, any model works unmodified — TP needs no ``seq_axis``-style
+model surgery.  The trade: communication placement is the compiler's choice,
+so the shard_map engine remains the default for pure data parallelism.
+
+Not supported here (use ``WindowedEngine``): ``commit_schedule`` staleness
+simulation and ``seq_shards`` ring attention (both are hand-placed-collective
+designs by nature).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.algorithms.base import UpdateRule
+from distkeras_tpu.models.adapter import ModelAdapter
+from distkeras_tpu.parallel.engine import (
+    VWORKER_AXIS,
+    TrainState,
+    WindowedEngine,
+    plan_workers,
+)
+from distkeras_tpu.parallel.mesh import WORKER_AXIS
+
+__all__ = ["GSPMDEngine", "TP_AXIS"]
+
+TP_AXIS = "model"
+
+
+class GSPMDEngine(WindowedEngine):
+    """Drop-in engine with data x tensor parallelism over a (workers, model)
+    mesh.  Same public surface as :class:`WindowedEngine` (``init_state``,
+    ``run_epoch``, ``shard_batches``, ``average_workers``, ...)."""
+
+    def __init__(
+        self,
+        adapter: ModelAdapter,
+        loss,
+        worker_optimizer,
+        rule: UpdateRule,
+        num_workers: Optional[int] = None,
+        *,
+        tp_shards: int = 1,
+        metrics: Sequence = ("accuracy",),
+        compute_dtype: Optional[Any] = None,
+        sync_model_state: bool = True,
+        devices: Optional[Sequence] = None,
+    ):
+        from distkeras_tpu.ops import get_loss, get_metric, get_optimizer
+
+        devices = list(devices if devices is not None else jax.devices())
+        self.tp_shards = int(tp_shards)
+        if len(devices) % self.tp_shards:
+            raise ValueError(
+                f"tp_shards={tp_shards} does not divide device count {len(devices)}"
+            )
+        worker_devices = len(devices) // self.tp_shards
+        self.adapter = adapter
+        self.rule = rule
+        self.num_workers = num_workers or worker_devices
+        # Same tiling policy as the shard_map engine: largest worker-axis
+        # size that divides num_workers; extra logical workers ride as
+        # leading-dim shards per device.
+        worker_devices, virtual = plan_workers(self.num_workers, worker_devices)
+        grid = np.array(devices[: worker_devices * self.tp_shards]).reshape(
+            worker_devices, self.tp_shards
+        )
+        self.mesh = Mesh(grid, (WORKER_AXIS, TP_AXIS))
+        self.axis = WORKER_AXIS
+        self.seq_axis = None
+        self.seq_shards = 1
+        self.n_dev, self.virtual = worker_devices, virtual
+        # The worker dimension is ONE vmap over all logical workers (XLA
+        # splits it across the mesh axis by sharding propagation), so the
+        # commit rules' psum reduces over just the vmap axis name.
+        self.both_axes = (VWORKER_AXIS,)
+        self.optimizer = get_optimizer(worker_optimizer)
+        self.loss_fn = get_loss(loss, from_logits=adapter.outputs_logits)
+        self.metric_fns = [get_metric(m) for m in metrics]
+        self.compute_dtype = compute_dtype
+        self.sync_model_state = sync_model_state
+        self.commit_schedule = None
+        self._rep = NamedSharding(self.mesh, P())
+        self._shard = NamedSharding(self.mesh, P(WORKER_AXIS))
+        self._epoch_fns = {}
+
+    # ------------------------------------------------------------- shardings
+    def _tp_spec(self, shape) -> P:
+        """Shape-based TP placement: shard the last dim of any >=2-D leaf that
+        splits evenly across the model axis.  Any placement is *correct* under
+        GSPMD (the partitioner inserts whatever collectives the placement
+        implies); this default puts matmul output channels — Dense/Conv
+        kernels, embeddings — on the model axis, Megatron column-parallel
+        style."""
+        if len(shape) >= 2 and shape[-1] % self.tp_shards == 0 and shape[-1] >= 2 * self.tp_shards:
+            return P(*([None] * (len(shape) - 1)), TP_AXIS)
+        return P()
+
+    def _constrain_center(self, tree):
+        return jax.tree.map(
+            lambda x: lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, self._tp_spec(x.shape))
+            ),
+            tree,
+        )
+
+    def _constrain_worker(self, tree):
+        """Per-worker trees ([num_workers, ...] leaves): workers axis on dim 0
+        plus the TP spec of the per-worker shape."""
+
+        def one(x):
+            if x.ndim >= 1 and x.shape[0] == self.num_workers:
+                spec = P(WORKER_AXIS, *self._tp_spec(x.shape[1:]))
+            else:
+                spec = P()
+            return lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
+
+        return jax.tree.map(one, tree)
+
+    # ------------------------------------------------------------------ init
+    def init_state(self, rng: jax.Array, sample_input) -> TrainState:
+        params, model_state = self.adapter.init(rng, sample_input)
+        n = self.num_workers
+
+        def _build(params, model_state):
+            params = self._constrain_center(params)
+            center_rule = self.rule.init_center_state()
+            tile = lambda t: jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
+            )
+            local_params = self._constrain_worker(tile(params))
+            opt_state = self._constrain_worker(
+                jax.vmap(self.optimizer.init)(local_params)
+            )
+            rule_local = self._constrain_worker(tile(self.rule.init_local_state(params)))
+            rngs = jax.random.split(jax.random.fold_in(rng, 1), n)
+            return TrainState(
+                center_params=params,
+                center_rule=center_rule,
+                local_params=local_params,
+                opt_state=opt_state,
+                model_state=self._constrain_worker(tile(model_state)),
+                rule_local=rule_local,
+                rng=rngs,
+                epoch=jnp.zeros((), jnp.int32),
+            )
+
+        with self.mesh:
+            return jax.jit(_build)(params, model_state)
+
+    # ------------------------------------------------------------------ epoch
+    def _make_epoch_fn(self, n_windows: int, window: int, do_commit: bool, xs_ndim: int = 5):
+        vmapped = jax.vmap(
+            self._window_fn(do_commit, window),
+            in_axes=(None, None, 0, 0),
+            out_axes=(0, 0, 0, 0, 0),
+            axis_name=VWORKER_AXIS,
+        )
+
+        def epoch_fn(state: TrainState, xs, ys):
+            xs = jnp.moveaxis(xs, 1, 0)  # scan over windows
+            ys = jnp.moveaxis(ys, 1, 0)
+            local = (state.local_params, state.opt_state, state.model_state,
+                     state.rule_local, state.rng)
+
+            def window_body(carry, wdata):
+                center_params, center_rule, local = carry
+                centers_p, centers_r, local, loss, mets = vmapped(
+                    center_params, center_rule, local, wdata
+                )
+                # psum over the vmap axis makes every worker's center copy
+                # identical; collapse the stacked dim and re-pin the TP
+                # sharding so the scan carry stays partitioned.
+                center_params = self._constrain_center(
+                    jax.tree.map(lambda x: x[0], centers_p)
+                )
+                center_rule = jax.tree.map(lambda x: x[0], centers_r)
+                local = (
+                    self._constrain_worker(local[0]),  # local_params
+                    local[1], local[2], local[3], local[4],
+                )
+                return (center_params, center_rule, local), (loss, mets)
+
+            (center_params, center_rule, local), (losses, mets) = lax.scan(
+                window_body,
+                (state.center_params, state.center_rule, local),
+                (xs, ys),
+            )
+            local_params, opt_state, model_state, rule_local, rng = local
+            # losses/mets carry a [n_windows, num_workers] leading block; the
+            # mean over workers is a plain reduction (XLA all-reduces it).
+            stats = {
+                "loss": jnp.mean(losses, axis=1),
+                "metrics": jnp.mean(mets, axis=1),
+            }
+            new_state = TrainState(
+                center_params=center_params,
+                center_rule=center_rule,
+                local_params=local_params,
+                opt_state=opt_state,
+                model_state=model_state,
+                rule_local=rule_local,
+                rng=rng,
+                epoch=state.epoch + 1,
+            )
+            return new_state, stats
+
+        return jax.jit(epoch_fn, donate_argnums=(0,))
+
+    def _make_stepwise_epoch_fn(self, n_steps: int, xs_ndim: int = 4):
+        raise NotImplementedError(
+            "commit_schedule staleness simulation requires the shard_map "
+            "engine (WindowedEngine)"
+        )
+
+    # ----------------------------------------------------------------- export
+    def gather_center(self, state: TrainState):
+        """Re-replicate the model-axis-sharded center leaves so every host
+        process can ``np.asarray`` them (trainer finalisation, PS attach)."""
+        with self.mesh:
+            return jax.jit(lambda t: t, out_shardings=self._rep)(state.center_params)
+
+    def worker_slice(self, tree, index: int):
+        with self.mesh:
+            sliced = jax.jit(
+                lambda t: jax.tree.map(lambda x: x[index], t),
+                out_shardings=self._rep,
+            )(tree)
+        return jax.tree.map(np.asarray, sliced)
+
+    # --------------------------------------------------------------- sharding
+    def shard_batches(self, xs: np.ndarray, ys: np.ndarray):
+        sharding = NamedSharding(self.mesh, P(WORKER_AXIS))
+        with self.mesh:
+            return (
+                jax.make_array_from_callback(xs.shape, sharding, lambda idx: xs[idx]),
+                jax.make_array_from_callback(ys.shape, sharding, lambda idx: ys[idx]),
+            )

@@ -74,6 +74,7 @@ class Trainer:
         resume: bool = False,
         profile_dir: Optional[str] = None,
         seq_shards: int = 1,
+        tp_shards: int = 1,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -98,6 +99,9 @@ class Trainer:
         # sequence parallelism (ring attention) shards: >1 requires a
         # seq-axis-aware model (models/transformer.py)
         self.seq_shards = int(seq_shards)
+        # tensor parallelism shards: >1 selects the GSPMD engine (param
+        # leaves sharded over a 'model' mesh axis; any model, unmodified)
+        self.tp_shards = int(tp_shards)
         self.history: dict = {}
         self.training_time: float = 0.0
         self._t0: Optional[float] = None
@@ -143,17 +147,36 @@ class Trainer:
     ):
         adapter = as_adapter(self.master_model)
         feats, labels = self._load_columns(dataframe)
-        engine = WindowedEngine(
-            adapter,
-            self.loss,
-            self.worker_optimizer,
-            rule,
-            num_workers,
-            metrics=self.metrics,
-            compute_dtype=self.compute_dtype,
-            commit_schedule=commit_schedule,
-            seq_shards=self.seq_shards,
-        )
+        if self.tp_shards > 1:
+            if self.seq_shards > 1 or commit_schedule is not None:
+                raise ValueError(
+                    "tp_shards>1 (GSPMD engine) is incompatible with "
+                    "seq_shards>1 and commit_schedule; use one or the other"
+                )
+            from distkeras_tpu.parallel.gspmd import GSPMDEngine
+
+            engine = GSPMDEngine(
+                adapter,
+                self.loss,
+                self.worker_optimizer,
+                rule,
+                num_workers,
+                tp_shards=self.tp_shards,
+                metrics=self.metrics,
+                compute_dtype=self.compute_dtype,
+            )
+        else:
+            engine = WindowedEngine(
+                adapter,
+                self.loss,
+                self.worker_optimizer,
+                rule,
+                num_workers,
+                metrics=self.metrics,
+                compute_dtype=self.compute_dtype,
+                commit_schedule=commit_schedule,
+                seq_shards=self.seq_shards,
+            )
         window = rule.communication_window if rule.communication_window > 0 else None
         rng = np.random.default_rng(self.seed)
         state = engine.init_state(jax.random.PRNGKey(self.seed), feats[: self.batch_size])
@@ -228,7 +251,7 @@ class Trainer:
     def _finalize(self, engine: WindowedEngine, state, adapter: ModelAdapter, use_center: bool = True):
         """Materialise the trained model in the same type the user passed in."""
         if use_center:
-            params = jax.tree.map(np.asarray, state.center_params)
+            params = jax.tree.map(np.asarray, engine.gather_center(state))
         else:
             params = engine.worker_slice(state.local_params, 0)
         model_state = jax.tree.map(np.asarray, engine.final_model_state(state))
@@ -327,11 +350,13 @@ class DistributedTrainer(Trainer):
         resume: bool = False,
         profile_dir: Optional[str] = None,
         seq_shards: int = 1,
+        tp_shards: int = 1,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
             checkpoint_dir, checkpoint_every, resume, profile_dir, seq_shards,
+            tp_shards,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port
@@ -400,7 +425,7 @@ class DistributedTrainer(Trainer):
             commit_schedule=self.commit_schedule,
         )
         self.parameter_server.attach(
-            state.center_params, jax.tree.map(np.asarray, state.center_rule),
+            engine.gather_center(state), jax.tree.map(np.asarray, state.center_rule),
         )
         self.stop_service()
         model = self._finalize(engine, state, adapter, use_center=True)

@@ -1,0 +1,42 @@
+"""bench.py must stay runnable: every config builds its engine, and
+run_config emits the driver's JSON schema.  Tiny shapes on the faked CPU
+mesh — this is a smoke test, not a measurement."""
+
+import json
+
+import numpy as np
+
+import bench
+
+
+def test_every_config_builds_engine():
+    for config in [
+        "cifar_cnn_downpour", "mnist_mlp_single", "mnist_cnn_downpour",
+        "cifar_cnn_aeasgd", "cifar_resnet20_adag", "imdb_textcnn_dynsgd",
+    ]:
+        engine, batch, window, shape, int_data, classes = bench._engine_for(config)
+        assert engine.num_workers >= 1
+        assert batch > 0 and window > 0 and classes > 1
+
+
+def test_run_config_schema(monkeypatch):
+    # Shrink the measurement so it runs in seconds on CPU.
+    import jax
+
+    engine, _, window, shape, int_data, classes = bench._engine_for("mnist_mlp_single")
+
+    def tiny_engine_for(config):
+        return engine, 8, window, shape, int_data, classes
+
+    monkeypatch.setattr(bench, "_engine_for", tiny_engine_for)
+    out = bench.run_config("mnist_mlp_single", n_windows=1, reps=1)
+    assert set(out) == {"metric", "value", "unit", "vs_baseline"}
+    assert out["unit"] == "samples/sec/chip"
+    assert out["value"] > 0
+    json.dumps(out)  # driver requires one JSON line
+
+
+def test_baseline_file_schema():
+    pins = json.load(open(bench.BASELINE_FILE))
+    assert isinstance(pins.get("configs"), dict)
+    assert all(isinstance(v, (int, float)) for v in pins["configs"].values())

@@ -1,0 +1,140 @@
+"""Tensor parallelism (GSPMD engine): param leaves sharded over a 'model'
+mesh axis, collectives inserted by the XLA partitioner.
+
+The reference has no TP (SURVEY.md §2 census: "out of reference scope;
+optional stretch via pjit param sharding") — these tests pin down that the
+stretch implementation changes *where arrays live*, never *what is computed*:
+the TP training trajectory must match the plain data-parallel one."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.algorithms import Adag, Downpour
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.models import MLP, FlaxModel, TransformerClassifier
+from distkeras_tpu.parallel import TP_AXIS, GSPMDEngine, WindowedEngine
+
+
+def _data(n=256, d=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.argmax(x @ rng.normal(size=(d, classes)), axis=1).astype(np.int32)
+    return x, y, np.eye(classes, dtype=np.float32)[y]
+
+
+def _epoch_arrays(x, onehot, num_workers, n_windows, window, batch):
+    n = num_workers * n_windows * window * batch
+    xs = x[:n].reshape(num_workers, n_windows, window, batch, -1)
+    ys = np.argmax(onehot[:n], -1).reshape(num_workers, n_windows, window, batch)
+    return xs, ys.astype(np.int32)
+
+
+def _run(engine, xs_np, ys_np, x0, epochs=2):
+    state = engine.init_state(jax.random.PRNGKey(0), x0)
+    xs, ys = engine.shard_batches(xs_np, ys_np)
+    for _ in range(epochs):
+        state, stats = engine.run_epoch(state, xs, ys)
+    return (jax.tree.map(np.asarray, state.center_params),
+            np.asarray(stats["loss"]))
+
+
+def test_tp_matches_dp_trajectory():
+    """4 workers x 2 model shards computes the same training run as
+    4 workers unsharded — TP is a layout, not an algorithm."""
+    x, y, onehot = _data()
+    adapter = lambda: FlaxModel(MLP(features=(32, 16), num_classes=4))
+    xs, ys = _epoch_arrays(x, onehot, num_workers=4, n_windows=2, window=4, batch=8)
+
+    dp = WindowedEngine(adapter(), "categorical_crossentropy",
+                        ("sgd", {"learning_rate": 0.05}), Downpour(4),
+                        num_workers=4, metrics=())
+    tp = GSPMDEngine(adapter(), "categorical_crossentropy",
+                     ("sgd", {"learning_rate": 0.05}), Downpour(4),
+                     num_workers=4, tp_shards=2, metrics=())
+    p_dp, loss_dp = _run(dp, xs, ys, x[:8])
+    p_tp, loss_tp = _run(tp, xs, ys, x[:8])
+
+    flat_dp, flat_tp = jax.tree.leaves(p_dp), jax.tree.leaves(p_tp)
+    assert len(flat_dp) == len(flat_tp)
+    for a, b in zip(flat_dp, flat_tp):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(loss_dp, loss_tp, rtol=2e-5, atol=2e-6)
+
+
+def test_tp_param_leaves_are_model_sharded():
+    x, _, onehot = _data()
+    engine = GSPMDEngine(FlaxModel(MLP(features=(32, 16), num_classes=4)),
+                         "categorical_crossentropy", "sgd", Downpour(4),
+                         num_workers=4, tp_shards=2, metrics=())
+    state = engine.init_state(jax.random.PRNGKey(0), x[:8])
+    specs = [
+        (leaf.shape, leaf.sharding.spec)
+        for leaf in jax.tree.leaves(state.center_params)
+    ]
+    tp_sharded = [s for shape, s in specs if TP_AXIS in jax.tree.leaves(tuple(s))]
+    # every 2-D kernel with an even last dim must land on the model axis
+    kernels = [shape for shape, _ in specs if len(shape) >= 2 and shape[-1] % 2 == 0]
+    assert len(tp_sharded) == len(kernels) and kernels, specs
+    # and per-worker state carries workers + model axes
+    local_specs = {
+        str(leaf.sharding.spec)
+        for leaf in jax.tree.leaves(state.local_params)
+    }
+    assert any(TP_AXIS in s for s in local_specs), local_specs
+
+
+def test_tp_virtual_workers():
+    """num_workers may exceed the worker mesh axis (8 logical on a 4x2 mesh)."""
+    x, y, onehot = _data(n=512)
+    xs, ys = _epoch_arrays(x, onehot, num_workers=8, n_windows=1, window=4, batch=8)
+    engine = GSPMDEngine(FlaxModel(MLP(features=(32,), num_classes=4)),
+                         "categorical_crossentropy", "sgd", Downpour(4),
+                         num_workers=8, tp_shards=2, metrics=())
+    params, loss = _run(engine, xs, ys, x[:8], epochs=1)
+    assert np.isfinite(loss).all()
+
+
+def test_trainer_level_tp_converges(toy_classification):
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    t = dk.DOWNPOUR(FlaxModel(MLP(features=(32,), num_classes=2)),
+                    loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=4, batch_size=16, num_epoch=8,
+                    communication_window=4, tp_shards=2)
+    trained = t.train(df)
+    h = t.get_history()["loss"]
+    assert h[-1] < h[0] * 0.6
+    preds = np.argmax(trained.predict(x), -1)
+    assert np.mean(preds == np.argmax(onehot, -1)) > 0.8
+
+
+def test_tp_transformer_adag():
+    """TP engine is model-agnostic: the (unmodified, seq_axis=None)
+    Transformer trains under ADAG on a (2 workers x 2 model) mesh."""
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 50, size=(128, 16)).astype(np.int32)
+    y = ((x == 7).sum(1) > (x == 3).sum(1)).astype(np.int32)
+    xs = x.reshape(2, 2, 4, 8, 16)
+    ys = y.reshape(2, 2, 4, 8).astype(np.int32)
+    engine = GSPMDEngine(
+        FlaxModel(TransformerClassifier(vocab_size=50, num_classes=2, dim=16,
+                                        heads=2, num_layers=1, max_len=16)),
+        "categorical_crossentropy", ("adam", {"learning_rate": 1e-3}),
+        Adag(4), num_workers=2, tp_shards=2, metrics=(),
+    )
+    params, loss = _run(engine, xs, ys, x[:8], epochs=1)
+    assert np.isfinite(loss).all()
+
+
+def test_tp_rejects_bad_combos():
+    with pytest.raises(ValueError):
+        # tp_shards must divide the device count (8 CPU devices in tests)
+        GSPMDEngine(FlaxModel(MLP()), "categorical_crossentropy", "sgd",
+                    Downpour(4), num_workers=4, tp_shards=3)
+    with pytest.raises(ValueError):
+        dk.DOWNPOUR(FlaxModel(MLP()), num_workers=4, tp_shards=2,
+                    seq_shards=2).train(from_numpy(*_data()[::2]))
