@@ -345,10 +345,10 @@ class WindowedEngine:
 
         return jax.jit(epoch_fn, donate_argnums=(0,))
 
-    # ---------------------------------------------- epoch (staleness-sim mode)
-    def _make_stepwise_epoch_fn(self, n_steps: int, xs_ndim: int = 4):
-        """Per-step masked commits with a per-worker commit period: the
-        faithful deterministic model of parameter-server asynchrony."""
+    def _step_fn(self):
+        """Build the one-worker masked-commit step body (staleness-sim mode).
+        Runs under ``vmap(axis_name=VWORKER_AXIS)`` — inside ``shard_map``
+        here, or under plain jit in the GSPMD engine."""
         rule = self.rule
 
         def per_worker_step(center_params, center_rule, local, since, batch, t, my_window):
@@ -368,8 +368,14 @@ class WindowedEngine:
             local = (local_params, opt_state, model_state, rule_local, rng)
             return center_params, center_rule, local, since, loss
 
+        return per_worker_step
+
+    # ---------------------------------------------- epoch (staleness-sim mode)
+    def _make_stepwise_epoch_fn(self, n_steps: int, xs_ndim: int = 4):
+        """Per-step masked commits with a per-worker commit period: the
+        faithful deterministic model of parameter-server asynchrony."""
         vmapped = jax.vmap(
-            per_worker_step,
+            self._step_fn(),
             in_axes=(None, None, 0, 0, 0, None, 0),
             out_axes=(0, 0, 0, 0, 0),
             axis_name=VWORKER_AXIS,

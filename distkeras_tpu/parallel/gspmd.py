@@ -24,9 +24,10 @@ collectives, any model works unmodified — TP needs no ``seq_axis``-style
 model surgery.  The trade: communication placement is the compiler's choice,
 so the shard_map engine remains the default for pure data parallelism.
 
-Not supported here (use ``WindowedEngine``): ``commit_schedule`` staleness
-simulation and ``seq_shards`` ring attention (both are hand-placed-collective
-designs by nature).
+``commit_schedule`` staleness simulation works here too (same per-step
+masked-commit body as the shard_map engine).  Not supported: ``seq_shards``
+ring attention, which is a hand-placed-collective design by nature — use
+``WindowedEngine`` for sequence parallelism.
 """
 
 from __future__ import annotations
@@ -71,6 +72,7 @@ class GSPMDEngine(WindowedEngine):
         metrics: Sequence = ("accuracy",),
         compute_dtype: Optional[Any] = None,
         sync_model_state: bool = True,
+        commit_schedule: Optional[np.ndarray] = None,
         devices: Optional[Sequence] = None,
     ):
         from distkeras_tpu.ops import get_loss, get_metric, get_optimizer
@@ -106,7 +108,14 @@ class GSPMDEngine(WindowedEngine):
         self.metric_fns = [get_metric(m) for m in metrics]
         self.compute_dtype = compute_dtype
         self.sync_model_state = sync_model_state
-        self.commit_schedule = None
+        self.commit_schedule = (
+            None if commit_schedule is None else np.asarray(commit_schedule, np.int32)
+        )
+        if self.commit_schedule is not None and len(self.commit_schedule) != self.num_workers:
+            raise ValueError(
+                f"commit_schedule has {len(self.commit_schedule)} entries for "
+                f"{self.num_workers} workers"
+            )
         self._rep = NamedSharding(self.mesh, P())
         self._shard = NamedSharding(self.mesh, P(WORKER_AXIS))
         self._epoch_fns = {}
@@ -235,10 +244,57 @@ class GSPMDEngine(WindowedEngine):
         return jax.jit(epoch_fn, donate_argnums=(0,))
 
     def _make_stepwise_epoch_fn(self, n_steps: int, xs_ndim: int = 4):
-        raise NotImplementedError(
-            "commit_schedule staleness simulation requires the shard_map "
-            "engine (WindowedEngine)"
+        """Staleness simulation under TP: the same per-step masked-commit body
+        as the shard_map engine, vmapped over all logical workers under jit."""
+        vmapped = jax.vmap(
+            self._step_fn(),
+            in_axes=(None, None, 0, 0, 0, None, 0),
+            out_axes=(0, 0, 0, 0, 0),
+            axis_name=VWORKER_AXIS,
         )
+        schedule_arr = jnp.asarray(self.commit_schedule, jnp.int32)
+
+        def epoch_fn(state: TrainState, xs, ys):
+            xs = jnp.moveaxis(xs, 1, 0)  # [n_steps, workers, batch, ...]
+            ys = jnp.moveaxis(ys, 1, 0)
+            local = (state.local_params, state.opt_state, state.model_state,
+                     state.rule_local, state.rng)
+
+            def step_body(carry, inp):
+                t, batch = inp
+                center_params, center_rule, local, since = carry
+                centers_p, centers_r, local, since, loss = vmapped(
+                    center_params, center_rule, local, since, batch, t, schedule_arr
+                )
+                center_params = self._constrain_center(
+                    jax.tree.map(lambda x: x[0], centers_p)
+                )
+                center_rule = jax.tree.map(lambda x: x[0], centers_r)
+                local = (self._constrain_worker(local[0]),
+                         local[1], local[2], local[3], local[4])
+                return (center_params, center_rule, local, since), loss
+
+            since0 = jnp.zeros((self.num_workers,), jnp.int32)
+            (center_params, center_rule, local, _), losses = lax.scan(
+                step_body,
+                (state.center_params, state.center_rule, local, since0),
+                (jnp.arange(n_steps), (xs, ys)),
+            )
+            local_params, opt_state, model_state, rule_local, rng = local
+            new_state = TrainState(
+                center_params=center_params,
+                center_rule=center_rule,
+                local_params=local_params,
+                opt_state=opt_state,
+                model_state=model_state,
+                rule_local=rule_local,
+                rng=rng,
+                epoch=state.epoch + 1,
+            )
+            return new_state, {"loss": jnp.mean(losses, axis=1),
+                               "metrics": jnp.zeros((0,))}
+
+        return jax.jit(epoch_fn, donate_argnums=(0,))
 
     # ----------------------------------------------------------------- export
     def gather_center(self, state: TrainState):

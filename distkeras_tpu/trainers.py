@@ -148,10 +148,10 @@ class Trainer:
         adapter = as_adapter(self.master_model)
         feats, labels = self._load_columns(dataframe)
         if self.tp_shards > 1:
-            if self.seq_shards > 1 or commit_schedule is not None:
+            if self.seq_shards > 1:
                 raise ValueError(
                     "tp_shards>1 (GSPMD engine) is incompatible with "
-                    "seq_shards>1 and commit_schedule; use one or the other"
+                    "seq_shards>1 (ring attention needs the shard_map engine)"
                 )
             from distkeras_tpu.parallel.gspmd import GSPMDEngine
 
@@ -164,6 +164,7 @@ class Trainer:
                 tp_shards=self.tp_shards,
                 metrics=self.metrics,
                 compute_dtype=self.compute_dtype,
+                commit_schedule=commit_schedule,
             )
         else:
             engine = WindowedEngine(
