@@ -1,6 +1,7 @@
-"""bench.py must stay runnable: every config builds its engine, and
-run_config emits the driver's JSON schema.  Tiny shapes on the faked CPU
-mesh — this is a smoke test, not a measurement."""
+"""bench.py must stay runnable: every config builds its engine, run_config
+emits the driver's JSON schema, and the harness converts failures into one
+parseable JSON line instead of a traceback (the round-1 regression).  Tiny
+shapes on the faked CPU mesh — this is a smoke test, not a measurement."""
 
 import json
 
@@ -10,10 +11,7 @@ import bench
 
 
 def test_every_config_builds_engine():
-    for config in [
-        "cifar_cnn_downpour", "mnist_mlp_single", "mnist_cnn_downpour",
-        "cifar_cnn_aeasgd", "cifar_resnet20_adag", "imdb_textcnn_dynsgd",
-    ]:
+    for config in bench.CONFIGS:
         engine, batch, window, shape, int_data, classes = bench._engine_for(config)
         assert engine.num_workers >= 1
         assert batch > 0 and window > 0 and classes > 1
@@ -21,22 +19,129 @@ def test_every_config_builds_engine():
 
 def test_run_config_schema(monkeypatch):
     # Shrink the measurement so it runs in seconds on CPU.
-    import jax
-
     engine, _, window, shape, int_data, classes = bench._engine_for("mnist_mlp_single")
 
-    def tiny_engine_for(config):
+    def tiny_engine_for(config, num_workers=None):
         return engine, 8, window, shape, int_data, classes
 
     monkeypatch.setattr(bench, "_engine_for", tiny_engine_for)
     out = bench.run_config("mnist_mlp_single", n_windows=1, reps=1)
-    assert set(out) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(out) == {"metric", "value", "unit", "vs_baseline", "mfu"}
     assert out["unit"] == "samples/sec/chip"
     assert out["value"] > 0
+    assert out["mfu"] is None  # CPU backend: no peak-FLOPs table entry
     json.dumps(out)  # driver requires one JSON line
 
 
-def test_baseline_file_schema():
+def test_vs_baseline_null_when_unpinned(monkeypatch, tmp_path):
+    engine, _, window, shape, int_data, classes = bench._engine_for("mnist_mlp_single")
+    monkeypatch.setattr(
+        bench, "_engine_for",
+        lambda config, num_workers=None: (engine, 8, window, shape, int_data, classes),
+    )
+    empty = tmp_path / "pins.json"
+    empty.write_text(json.dumps({"configs": {}}))
+    monkeypatch.setattr(bench, "BASELINE_FILE", str(empty))
+    out = bench.run_config("mnist_mlp_single", n_windows=1, reps=1)
+    assert out["vs_baseline"] is None  # not 1.0: unpinned must be distinguishable
+
+
+def test_baseline_file_pins_every_config():
     pins = json.load(open(bench.BASELINE_FILE))
     assert isinstance(pins.get("configs"), dict)
     assert all(isinstance(v, (int, float)) for v in pins["configs"].values())
+    assert bench.HEADLINE in pins["configs"], "headline config must be pinned"
+    missing = [c for c in bench.CONFIGS if c not in pins["configs"]]
+    if missing:
+        # Pins require one bench run on real TPU hardware; until the next
+        # window where the chip is reachable, unpinned configs report
+        # vs_baseline null (tested above) rather than a fake 1.0.
+        import pytest
+
+        pytest.xfail(f"configs awaiting a real-TPU pin run: {missing}")
+
+
+def test_emit_error_is_parseable_json(capsys):
+    bench._emit_error("TPU fell over")
+    line = capsys.readouterr().out.strip()
+    parsed = json.loads(line)
+    assert parsed["metric"] == bench.HEADLINE_METRIC
+    assert parsed["value"] is None and parsed["vs_baseline"] is None
+    assert "TPU fell over" in parsed["error"]
+
+
+def test_main_emits_json_line_when_backend_unavailable(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "preflight", lambda **kw: {"error": "UNAVAILABLE: nope"})
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    bench.main()  # must not raise
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed["value"] is None
+    assert "UNAVAILABLE" in parsed["error"]
+
+
+def test_main_emits_json_line_when_config_raises(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "preflight", lambda **kw: {"n": 1, "platform": "cpu", "kind": "cpu"})
+
+    def boom(config, **kw):
+        raise RuntimeError("compile exploded")
+
+    monkeypatch.setattr(bench, "run_config", boom)
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    bench.main()
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed["metric"] == bench.HEADLINE_METRIC
+    assert "compile exploded" in parsed["error"]
+
+
+def test_preflight_succeeds_after_live_probe(monkeypatch):
+    # The child probe targets the default backend (TPU under the driver);
+    # here it's stubbed live so preflight proceeds to the in-process init,
+    # which conftest pins to the 8-device CPU mesh.
+    monkeypatch.setattr(bench, "_probe_subprocess", lambda timeout: (True, ""))
+    out = bench.preflight(init_timeout=60)
+    assert out.get("n", 0) >= 1
+
+
+def test_preflight_gives_up_on_nontransient_probe_failure(monkeypatch):
+    calls = []
+
+    def dead_probe(timeout):
+        calls.append(timeout)
+        return False, "NotFoundError: no such platform"
+
+    monkeypatch.setattr(bench, "_probe_subprocess", dead_probe)
+    out = bench.preflight(init_timeout=1, retry_sleep=0)
+    assert "error" in out
+    assert len(calls) == 1  # non-transient: no pointless retries
+
+
+def test_preflight_retries_transient_unavailable(monkeypatch):
+    calls = []
+
+    def flaky_probe(timeout):
+        calls.append(timeout)
+        if len(calls) < 3:
+            return False, "UNAVAILABLE: TPU backend setup/compile error"
+        return True, ""
+
+    monkeypatch.setattr(bench, "_probe_subprocess", flaky_probe)
+    out = bench.preflight(init_timeout=60, retry_sleep=0)
+    assert out.get("n", 0) >= 1
+    assert len(calls) == 3
+
+
+def test_scaling_sweep_schema(monkeypatch):
+    calls = []
+
+    def fake_run_config(config, num_workers=None, **kw):
+        calls.append(num_workers)
+        return {"value": 100.0 * (0.95 ** (num_workers or 1))}
+
+    monkeypatch.setattr(bench, "run_config", fake_run_config)
+    monkeypatch.setattr(bench, "_peak_flops", lambda kind: None)
+    out = bench.run_scaling("cifar_cnn_downpour")
+    assert out["metric"] == "cifar_cnn_downpour_scaling_efficiency"
+    assert out["num_chips"] == max(calls)
+    assert 0 < out["value"] <= 1.0
+    assert set(out["points_samples_per_sec_per_chip"]) == {str(c) for c in calls}
+    json.dumps(out)
