@@ -2,9 +2,13 @@
 
 ``ModelPredictor.predict(df)`` appends a ``prediction`` column.  The reference
 deserialises the Keras model once per Spark partition and loops rows in
-Python; here inference is one jitted, batched forward pass, sharded over the
-device mesh when more than one chip is visible (batch data parallelism via
-positional sharding — the TPU-native ``mapPartitions``).
+Python (``distkeras/predictors.py :: ModelPredictor._predict``); here
+inference is a jitted, batched forward pass.  When more than one device is
+visible and the frame is at least ``distribute_threshold`` rows, each global
+batch is sharded over the ``workers`` mesh axis (params replicated, batch
+axis split — the TPU-native ``mapPartitions``) and every chip runs its shard
+in the same XLA program; smaller frames take the single-device path, where
+sharding overhead would dominate.
 """
 
 from __future__ import annotations
@@ -16,7 +20,7 @@ import numpy as np
 
 from distkeras_tpu.frame import DataFrame
 from distkeras_tpu.models.adapter import ModelAdapter, TrainedModel, as_adapter
-from distkeras_tpu.parallel.mesh import make_mesh, worker_sharding
+from distkeras_tpu.parallel.mesh import make_mesh, replicated_sharding, worker_sharding
 
 __all__ = ["Predictor", "ModelPredictor"]
 
@@ -30,7 +34,9 @@ class ModelPredictor(Predictor):
     """Append model outputs as a ``prediction`` column.
 
     Accepts what trainers return: a Keras model, a :class:`TrainedModel`, or
-    (adapter, params, state).
+    (adapter, params, state).  A bare flax module without ``params`` is
+    initialised lazily from the first predicted batch (the input shape is
+    only knowable from real data — init-time dummy shapes broke conv models).
     """
 
     def __init__(
@@ -41,6 +47,8 @@ class ModelPredictor(Predictor):
         batch_size: int = 512,
         params: Any = None,
         state: Any = None,
+        num_devices: Optional[int] = None,
+        distribute_threshold: Optional[int] = None,
     ):
         self.features_col = features_col
         self.output_col = output_col
@@ -51,15 +59,31 @@ class ModelPredictor(Predictor):
             self.state = keras_model.state
         else:
             self.adapter = as_adapter(keras_model)
-            if params is None:
-                self.params, self.state = self.adapter.init(
-                    jax.random.key(0), np.zeros((1, 1), np.float32)
-                ) if not hasattr(self.adapter, "model") else self._keras_vars()
-            else:
+            if params is not None:
                 self.params, self.state = params, state or {}
-        self._jit_apply = jax.jit(
-            lambda p, s, x: self.adapter.apply(p, s, x, training=False)[0]
+            elif hasattr(self.adapter, "model"):
+                self.params, self.state = self._keras_vars()
+            else:
+                self.params = None  # lazy: init from the first real batch
+                self.state = {}
+        self.mesh = make_mesh(num_devices)
+        self.n_dev = int(self.mesh.devices.size)
+        # Below this many rows the mesh path isn't worth the put/gather.
+        self.distribute_threshold = (
+            int(distribute_threshold) if distribute_threshold is not None
+            else self.batch_size
         )
+        self._rep = replicated_sharding(self.mesh)
+        self._shard = worker_sharding(self.mesh)
+        fwd = lambda p, s, x: self.adapter.apply(p, s, x, training=False)[0]
+        self._jit_apply = jax.jit(fwd)
+        self._jit_apply_sharded = jax.jit(
+            fwd,
+            in_shardings=(self._rep, self._rep, self._shard),
+            out_shardings=self._shard,
+        )
+        #: how the last ``predict`` ran: None | "single" | "distributed"
+        self.last_mode = None
 
     def _keras_vars(self):
         m = self.adapter.model
@@ -68,6 +92,14 @@ class ModelPredictor(Predictor):
             {"ntv": [v.value for v in m.non_trainable_variables]},
         )
 
+    def _ensure_params(self, sample: np.ndarray):
+        if self.params is None:
+            self.params, self.state = self.adapter.init(jax.random.key(0), sample)
+
+    def _shard_batch(self, chunk: np.ndarray):
+        """Device-put one global batch split over the workers mesh axis."""
+        return jax.device_put(chunk, self._shard)
+
     def predict(self, dataframe: DataFrame) -> DataFrame:
         col = dataframe.column(self.features_col)
         feats = dataframe.matrix(
@@ -75,14 +107,26 @@ class ModelPredictor(Predictor):
             dtype=np.int32 if (col.dtype != object and np.issubdtype(col.dtype, np.integer)) else np.float32,
         )
         n = len(feats)
+        self._ensure_params(feats[:1])
+        distributed = self.n_dev > 1 and n >= self.distribute_threshold
+        self.last_mode = "distributed" if distributed else "single"
+        # One compiled shape: pad the tail batch, slice the output.  The
+        # distributed path widens the batch so every chip gets batch_size rows.
+        bs = self.batch_size * (self.n_dev if distributed else 1)
         outs = []
-        bs = self.batch_size
         for i in range(0, n, bs):
             chunk = feats[i : i + bs]
             pad = bs - len(chunk)
-            if pad:  # static shapes: pad the tail batch, slice the output
+            if pad:
                 chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
-            out = np.asarray(self._jit_apply(self.params, self.state, chunk))
+            if distributed:
+                with self.mesh:
+                    out = self._jit_apply_sharded(
+                        self.params, self.state, self._shard_batch(chunk)
+                    )
+                out = np.asarray(out)
+            else:
+                out = np.asarray(self._jit_apply(self.params, self.state, chunk))
             outs.append(out[: bs - pad] if pad else out)
         preds = np.concatenate(outs) if outs else np.zeros((0,))
         if self.adapter.outputs_logits and preds.ndim > 1 and preds.shape[-1] > 1:

@@ -1,0 +1,82 @@
+"""Distributed inference (VERDICT r1 item 2): ModelPredictor must actually
+shard batches over the device mesh — per-device shards on the 8-CPU mesh,
+outputs equal to the single-device path — and a bare flax module without
+params must lazily initialise from real data (conv input shapes included)."""
+
+import numpy as np
+
+import jax
+
+from distkeras_tpu import frame
+from distkeras_tpu.models import CIFARCNN, MLP, FlaxModel
+from distkeras_tpu.predictors import ModelPredictor
+
+
+def _digits_df(n=640, d=16):
+    rng = np.random.default_rng(0)
+    return frame.from_numpy(rng.normal(size=(n, d)).astype(np.float32))
+
+
+def _trained_mlp(d=16):
+    adapter = FlaxModel(MLP(features=(32,), num_classes=4))
+    params, state = adapter.init(jax.random.key(0), np.zeros((2, d), np.float32))
+    return adapter, params, state
+
+
+def test_distributed_predict_matches_single_device():
+    adapter, params, state = _trained_mlp()
+    df = _digits_df(n=640)
+    dist = ModelPredictor(adapter, params=params, state=state,
+                          batch_size=64, distribute_threshold=1)
+    single = ModelPredictor(adapter, params=params, state=state,
+                            batch_size=64, num_devices=1)
+    out_d = dist.predict(df).column("prediction")
+    out_s = single.predict(df).column("prediction")
+    assert dist.last_mode == "distributed" and dist.n_dev == jax.device_count()
+    assert single.last_mode == "single"
+    np.testing.assert_allclose(np.stack(out_d), np.stack(out_s), rtol=1e-5, atol=1e-6)
+
+
+def test_batches_are_sharded_per_device():
+    adapter, params, state = _trained_mlp()
+    p = ModelPredictor(adapter, params=params, state=state, batch_size=8)
+    chunk = np.zeros((8 * p.n_dev, 16), np.float32)
+    sharded = p._shard_batch(chunk)
+    shards = sharded.addressable_shards
+    assert len(shards) == p.n_dev == jax.device_count()
+    assert len({s.device for s in shards}) == p.n_dev
+    assert all(s.data.shape[0] == 8 for s in shards)
+
+
+def test_small_frames_fall_back_to_single_device():
+    adapter, params, state = _trained_mlp()
+    p = ModelPredictor(adapter, params=params, state=state,
+                       batch_size=64, distribute_threshold=64)
+    p.predict(_digits_df(n=16))
+    assert p.last_mode == "single"
+
+
+def test_uneven_tail_batch_is_exact():
+    adapter, params, state = _trained_mlp()
+    n = 8 * 64 + 13  # forces a padded tail global batch
+    df = _digits_df(n=n)
+    dist = ModelPredictor(adapter, params=params, state=state,
+                          batch_size=64, distribute_threshold=1)
+    single = ModelPredictor(adapter, params=params, state=state,
+                            batch_size=64, num_devices=1)
+    out_d = np.stack(dist.predict(df).column("prediction"))
+    out_s = np.stack(single.predict(df).column("prediction"))
+    assert len(out_d) == n
+    np.testing.assert_allclose(out_d, out_s, rtol=1e-5, atol=1e-6)
+
+
+def test_lazy_init_from_real_batch_handles_conv_models():
+    # Round-1 bug: bare flax module without params init'd with zeros((1, 1)),
+    # which throws for conv models.  Now init comes from the first real batch.
+    rng = np.random.default_rng(1)
+    imgs = rng.normal(size=(32, 32, 32, 3)).astype(np.float32)
+    df = frame.from_numpy(imgs)
+    p = ModelPredictor(FlaxModel(CIFARCNN()), batch_size=16)
+    out = p.predict(df).column("prediction")
+    assert np.stack(out).shape == (32, 10)
+    np.testing.assert_allclose(np.stack(out).sum(axis=-1), 1.0, rtol=1e-4)
