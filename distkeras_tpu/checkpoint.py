@@ -6,6 +6,11 @@ the run).  Here the full training state — center params, per-worker local
 replicas, optimizer state, rule state (clocks/anchors), epoch counter —
 checkpoints through Orbax, so an interrupted distributed run resumes exactly
 (bitwise, given the same data order seed).
+
+Saves are asynchronous (``ocp.AsyncCheckpointer``): the host thread returns
+as soon as the state is snapshotted, so per-epoch checkpointing stays off
+the training path; ``CheckpointManager.wait()`` (called by trainers at the
+end of the epoch loop, and implicitly before any restore) flushes the queue.
 """
 
 from __future__ import annotations
@@ -18,21 +23,41 @@ import numpy as np
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step", "CheckpointManager"]
 
+_CHECKPOINTER = None
+
 
 def _checkpointer():
-    import orbax.checkpoint as ocp
+    """Singleton async checkpointer on the current (non-deprecated) Orbax
+    API: ``AsyncCheckpointer(StandardCheckpointHandler)`` with explicit
+    ``args.StandardSave/StandardRestore`` (the round-1 ``PyTreeCheckpointer``
+    is deprecated upstream)."""
+    global _CHECKPOINTER
+    if _CHECKPOINTER is None:
+        import orbax.checkpoint as ocp
 
-    return ocp.PyTreeCheckpointer()
+        _CHECKPOINTER = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _CHECKPOINTER
+
+
+def wait_until_finished() -> None:
+    """Block until every in-flight async save has committed."""
+    if _CHECKPOINTER is not None:
+        _CHECKPOINTER.wait_until_finished()
 
 
 def save_checkpoint(directory: str, state: Any, step: int) -> str:
-    """Write training state under ``directory/step_N``; returns the path."""
+    """Write training state under ``directory/step_N`` (async); returns the
+    path.  Call :func:`wait_until_finished` before reading it back."""
+    import orbax.checkpoint as ocp
+
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    _checkpointer().save(path, jax.tree.map(np.asarray, state))
+    host_state = jax.tree.map(np.asarray, state)
+    _checkpointer().save(path, args=ocp.args.StandardSave(host_state))
     return path
 
 
 def latest_step(directory: str) -> Optional[int]:
+    wait_until_finished()  # a step only counts once its async save committed
     directory = os.path.abspath(directory)
     if not os.path.isdir(directory):
         return None
@@ -47,12 +72,18 @@ def latest_step(directory: str) -> Optional[int]:
 def restore_checkpoint(directory: str, step: Optional[int] = None, like: Any = None) -> Any:
     """Load training state; ``like`` (a template pytree, e.g. a freshly built
     TrainState) restores exact structure/dtypes and device placement."""
+    import orbax.checkpoint as ocp
+
+    wait_until_finished()
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    restored = _checkpointer().restore(path, item=jax.tree.map(np.asarray, like) if like is not None else None)
+    template = jax.tree.map(np.asarray, like) if like is not None else None
+    restored = _checkpointer().restore(
+        path, args=ocp.args.StandardRestore(template)
+    )
     if like is not None:
         # re-place on the same shardings as the template
         return jax.tree.map(
@@ -73,24 +104,37 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         self.every = max(1, int(every))
         self.keep = keep
+        self._saved: set[int] = set()
         os.makedirs(self.directory, exist_ok=True)
 
     def maybe_save(self, state: Any, epoch: int) -> Optional[str]:
         if (epoch + 1) % self.every:
             return None
         path = save_checkpoint(self.directory, state, epoch + 1)
+        self._saved.add(epoch + 1)
         self._gc()
         return path
 
+    def wait(self) -> None:
+        """Flush in-flight async saves (end of the trainer epoch loop)."""
+        wait_until_finished()
+
     def _gc(self) -> None:
-        steps = sorted(
+        # The newest save may still be in flight and not yet on disk, so gc
+        # works from the union of the directory listing and the steps this
+        # manager initiated; the in-flight step is always the newest and
+        # keep >= 1 protects it.  Older steps are fully committed (the async
+        # checkpointer serialises saves), so removing them is safe.
+        on_disk = {
             int(d.split("_", 1)[1])
             for d in os.listdir(self.directory)
             if d.startswith("step_") and d.split("_", 1)[1].isdigit()
-        )
+        }
+        steps = sorted(on_disk | self._saved)
         import shutil
 
         for s in steps[: -self.keep] if self.keep else []:
+            self._saved.discard(s)
             shutil.rmtree(os.path.join(self.directory, f"step_{s}"), ignore_errors=True)
 
     def latest(self) -> Optional[int]:

@@ -75,6 +75,8 @@ class Trainer:
         profile_dir: Optional[str] = None,
         seq_shards: int = 1,
         tp_shards: int = 1,
+        tensorboard_dir: Optional[str] = None,
+        streaming: bool = False,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -96,6 +98,13 @@ class Trainer:
         # SURVEY.md §5.1: the reference only wall-clocked training; we add
         # optional per-epoch device tracing viewable in TensorBoard/Perfetto.
         self.profile_dir = profile_dir
+        # SURVEY.md §5.5: optional per-epoch loss/metric scalars (TensorBoard
+        # event files when a writer is importable, JSONL otherwise).
+        self.tensorboard_dir = tensorboard_dir
+        # Streaming data path: feed the engine window-sized blocks through a
+        # double-buffered iterator instead of materialising whole epochs
+        # (identical trajectory; for datasets approaching HBM size).
+        self.streaming = bool(streaming)
         # sequence parallelism (ring attention) shards: >1 requires a
         # seq-axis-aware model (models/transformer.py)
         self.seq_shards = int(seq_shards)
@@ -118,6 +127,13 @@ class Trainer:
 
     def get_history(self) -> dict:
         return self.history
+
+    def _effective_worker_optimizer(self):
+        """The optimizer spec handed to engines/workers.  Subclasses with an
+        algorithm-specific default (EAMSGD) override this instead of mutating
+        ``self.worker_optimizer``, so retraining after changing hyperparams
+        resolves a fresh spec."""
+        return self.worker_optimizer
 
     # -- internals ----------------------------------------------------------
     def _load_columns(self, dataframe: DataFrame):
@@ -158,7 +174,7 @@ class Trainer:
             engine = GSPMDEngine(
                 adapter,
                 self.loss,
-                self.worker_optimizer,
+                self._effective_worker_optimizer(),
                 rule,
                 num_workers,
                 tp_shards=self.tp_shards,
@@ -170,7 +186,7 @@ class Trainer:
             engine = WindowedEngine(
                 adapter,
                 self.loss,
-                self.worker_optimizer,
+                self._effective_worker_optimizer(),
                 rule,
                 num_workers,
                 metrics=self.metrics,
@@ -196,42 +212,95 @@ class Trainer:
         for _ in range(start_epoch):
             rng.permutation(len(feats))
 
+        scalar_log = None
+        if self.tensorboard_dir:
+            from distkeras_tpu.utils.tb import ScalarLogger
+
+            scalar_log = ScalarLogger(self.tensorboard_dir)
+
+        def _materialise(stats, epoch_idx):
+            stats = jax.tree.map(np.asarray, stats)
+            if scalar_log is not None:
+                scalars = {"loss": float(np.mean(stats["loss"]))}
+                mets = np.asarray(stats["metrics"])
+                if mets.size:
+                    per_metric = np.mean(mets, axis=0)
+                    for i, name in enumerate(self.metrics):
+                        key = name if isinstance(name, str) else getattr(name, "__name__", f"metric_{i}")
+                        scalars[key] = float(per_metric[i])
+                scalar_log.log(epoch_idx, **scalars)
+            return stats
+
         epoch_stats: List[dict] = []
         self.record_training_start()
-        for epoch in range(start_epoch, self.num_epoch):
-            if window is None:
-                # single window spanning the whole epoch (no commits)
-                from distkeras_tpu.data import plan_epoch
+        if self.streaming and commit_schedule is not None:
+            raise ValueError(
+                "streaming=True is incompatible with commit_schedule: the "
+                "staleness simulation scans the whole epoch in one program"
+            )
+        stream_window = window
+        if self.streaming and window is None:
+            # No-commit trainers (SingleTrainer/Ensemble) have no natural
+            # window; stream in fixed blocks with a ragged tail
+            # (pad_to_window=False below), so the step count — and therefore
+            # the trajectory — matches the in-memory path exactly.  The tail
+            # costs one extra compile; forcing divisor-sized blocks instead
+            # could degenerate to 1-step dispatches on prime step counts.
+            from distkeras_tpu.data import plan_epoch
 
-                steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
-                xs, ys = epoch_arrays(
-                    feats, labels, num_workers, self.batch_size, steps,
+            steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
+            stream_window = min(steps, 32)
+        for epoch in range(start_epoch, self.num_epoch):
+            if self.streaming:
+                from distkeras_tpu.data import epoch_window_iter
+
+                blocks = epoch_window_iter(
+                    feats, labels, num_workers, self.batch_size, stream_window,
                     rng=rng if shuffle else None,
+                    pad_to_window=window is not None,
                 )
+                run_one = lambda blocks=blocks: engine.run_epoch_streaming(state, blocks)
             else:
-                xs, ys = epoch_arrays(
-                    feats, labels, num_workers, self.batch_size, window,
-                    stepwise=commit_schedule is not None,
-                    rng=rng if shuffle else None,
-                )
-            xs, ys = engine.shard_batches(xs, ys)
+                if window is None:
+                    # single window spanning the whole epoch (no commits)
+                    from distkeras_tpu.data import plan_epoch
+
+                    steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
+                    xs, ys = epoch_arrays(
+                        feats, labels, num_workers, self.batch_size, steps,
+                        rng=rng if shuffle else None,
+                    )
+                else:
+                    xs, ys = epoch_arrays(
+                        feats, labels, num_workers, self.batch_size, window,
+                        stepwise=commit_schedule is not None,
+                        rng=rng if shuffle else None,
+                    )
+                xs, ys = engine.shard_batches(xs, ys)
+                run_one = lambda xs=xs, ys=ys: engine.run_epoch(state, xs, ys)
             # Trace the second epoch (the first includes compilation), or the
             # only epoch when there is just one.
             if self.profile_dir and epoch == min(start_epoch + 1, self.num_epoch - 1):
                 with jax.profiler.trace(self.profile_dir):
-                    state, stats = engine.run_epoch(state, xs, ys)
+                    state, stats = run_one()
                     jax.block_until_ready(state.center_params)
             else:
-                state, stats = engine.run_epoch(state, xs, ys)
+                state, stats = run_one()
             # keep the current epoch's stats as device arrays: dispatch is
             # async, so the next epoch's host-side batching overlaps this
             # epoch's device compute.  Materialise the previous epoch's stats
             # now (its compute is long done) so retention stays O(1).
             if epoch_stats:
-                epoch_stats[-1] = jax.tree.map(np.asarray, epoch_stats[-1])
+                epoch_stats[-1] = _materialise(epoch_stats[-1], epoch - 1)
             epoch_stats.append(stats)
             if ckpt is not None:
                 ckpt.maybe_save(state, epoch)
+        if epoch_stats:
+            epoch_stats[-1] = _materialise(epoch_stats[-1], self.num_epoch - 1)
+        if ckpt is not None:
+            ckpt.wait()  # flush in-flight async saves before declaring done
+        if scalar_log is not None:
+            scalar_log.close()
         if average_at_end:
             state, _ = engine.average_workers(state)
 
@@ -352,12 +421,14 @@ class DistributedTrainer(Trainer):
         profile_dir: Optional[str] = None,
         seq_shards: int = 1,
         tp_shards: int = 1,
+        tensorboard_dir: Optional[str] = None,
+        streaming: bool = False,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
             checkpoint_dir, checkpoint_every, resume, profile_dir, seq_shards,
-            tp_shards,
+            tp_shards, tensorboard_dir, streaming,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port
@@ -398,17 +469,35 @@ class DistributedTrainer(Trainer):
         the trainer reloads the latest checkpoint and resumes.  Requires
         ``checkpoint_dir``; each retry restarts from the last completed
         checkpointed epoch (bit-exact — see test_checkpoint).
+
+        Retries are reserved for transient failures: a retry happens only if
+        a checkpoint exists to restore from, and never for the same exception
+        signature twice in a row — a deterministic bug (shape error, OOM)
+        surfaces immediately instead of being re-run ``max_retries`` times.
         """
         if not self.checkpoint_dir:
             raise ValueError("train_with_recovery requires checkpoint_dir")
+        from distkeras_tpu.checkpoint import latest_step
+
         attempts = 0
+        last_failure = None
+        last_step = None
         while True:
             try:
                 return self.train(dataframe, shuffle)
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — re-raised unless retryable
+                failure = (type(e), str(e))
+                step = latest_step(self.checkpoint_dir)
+                if step != last_step:
+                    # checkpointed progress since the previous failure: a
+                    # repeating signature is a recurring *transient* (e.g.
+                    # periodic preemption), not a deterministic bug
+                    last_failure = None
                 attempts += 1
-                if attempts > max_retries:
+                if attempts > max_retries or failure == last_failure or step is None:
                     raise
+                last_failure = failure
+                last_step = step
                 self.resume = True  # pick up from the latest checkpoint
 
     @property
@@ -487,21 +576,23 @@ class EAMSGD(AsynchronousDistributedTrainer):
         self.learning_rate = learning_rate
         self.momentum = momentum
 
-    def allocate_worker(self):
-        return workers_mod.EAMSGDWorker(
-            self.worker_optimizer, self.batch_size, self.features_col, self.label_col,
-            self.communication_window, self.rho, self.learning_rate, self.momentum,
+    def _effective_worker_optimizer(self):
+        # default worker optimizer = Nesterov momentum SGD (the reference's
+        # explicit velocity update on the local variable), resolved fresh per
+        # train() call so changed learning_rate/momentum take effect on retrain
+        if self.worker_optimizer is not None:
+            return self.worker_optimizer
+        return (
+            "sgd",
+            {"learning_rate": self.learning_rate, "momentum": self.momentum, "nesterov": True},
         )
 
-    def train(self, dataframe: DataFrame, shuffle: bool = False):
-        # default worker optimizer = Nesterov momentum SGD (the reference's
-        # explicit velocity update on the local variable)
-        if self.worker_optimizer is None:
-            self.worker_optimizer = (
-                "sgd",
-                {"learning_rate": self.learning_rate, "momentum": self.momentum, "nesterov": True},
-            )
-        return super().train(dataframe, shuffle)
+    def allocate_worker(self):
+        return workers_mod.EAMSGDWorker(
+            self._effective_worker_optimizer(), self.batch_size, self.features_col,
+            self.label_col, self.communication_window, self.rho, self.learning_rate,
+            self.momentum,
+        )
 
 
 class ADAG(AsynchronousDistributedTrainer):

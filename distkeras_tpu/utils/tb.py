@@ -1,0 +1,65 @@
+"""Per-epoch scalar logging (SURVEY.md §5.5 rebuild note).
+
+The reference's observability was a stdout print + the ``num_updates``
+counter; here trainers accept ``tensorboard_dir`` and emit per-epoch
+loss/metric scalars.  TensorBoard event files are written when a writer is
+importable (``torch.utils.tensorboard``, then ``tf.summary``); otherwise the
+scalars land in ``<dir>/scalars.jsonl`` — same data, greppable, no heavy
+dependency on the training path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["ScalarLogger"]
+
+
+class ScalarLogger:
+    """Append-only scalar sink: ``log(step, loss=..., accuracy=...)``."""
+
+    def __init__(self, logdir: str):
+        self.logdir = os.path.abspath(logdir)
+        os.makedirs(self.logdir, exist_ok=True)
+        self._writer = None
+        self._write = self._write_jsonl
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._writer = SummaryWriter(self.logdir)
+            self._write = self._write_torch
+        except Exception:
+            try:
+                import tensorflow as tf
+
+                self._writer = tf.summary.create_file_writer(self.logdir)
+                self._write = self._write_tf
+            except Exception:
+                self._jsonl = open(os.path.join(self.logdir, "scalars.jsonl"), "a")
+
+    def _write_torch(self, step, scalars):
+        for name, value in scalars.items():
+            self._writer.add_scalar(name, value, step)
+        self._writer.flush()
+
+    def _write_tf(self, step, scalars):
+        import tensorflow as tf
+
+        with self._writer.as_default(step=step):
+            for name, value in scalars.items():
+                tf.summary.scalar(name, value)
+        self._writer.flush()
+
+    def _write_jsonl(self, step, scalars):
+        self._jsonl.write(json.dumps({"step": step, **scalars}) + "\n")
+        self._jsonl.flush()
+
+    def log(self, step: int, **scalars: float) -> None:
+        self._write(int(step), {k: float(v) for k, v in scalars.items()})
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        elif hasattr(self, "_jsonl"):
+            self._jsonl.close()

@@ -1,0 +1,125 @@
+"""Round-2 bug-fix regressions (VERDICT r1 weak items 6, 7 + §5.5 logging):
+EAMSGD hyperparameter changes take effect on retrain, train_with_recovery
+doesn't blindly re-run deterministic bugs, and tensorboard_dir emits
+per-epoch scalars."""
+
+import os
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.models import MLP, FlaxModel
+from distkeras_tpu.parallel.engine import WindowedEngine
+
+
+def _mlp():
+    return FlaxModel(MLP(features=(16,), num_classes=2))
+
+
+def test_eamsgd_retrain_picks_up_new_learning_rate(toy_classification):
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    t = dk.EAMSGD(_mlp(), loss="categorical_crossentropy", num_workers=2,
+                  batch_size=16, num_epoch=1, communication_window=4,
+                  learning_rate=0.05, seed=3)
+    t.train(df)
+    assert t.worker_optimizer is None  # train() must not mutate the spec
+    opt_name, opt_kwargs = t._effective_worker_optimizer()
+    assert opt_kwargs["learning_rate"] == 0.05
+
+    t.learning_rate = 0.001  # retrain with a changed hyperparameter
+    _, opt_kwargs = t._effective_worker_optimizer()
+    assert opt_kwargs["learning_rate"] == 0.001  # round 1: stale 0.05
+
+
+def test_eamsgd_explicit_optimizer_wins(toy_classification):
+    t = dk.EAMSGD(_mlp(), worker_optimizer=("sgd", {"learning_rate": 0.2}),
+                  num_workers=2, learning_rate=0.05)
+    assert t._effective_worker_optimizer() == ("sgd", {"learning_rate": 0.2})
+
+
+def test_recovery_does_not_retry_without_checkpoint(toy_classification, tmp_path, monkeypatch):
+    """A failure before any checkpoint exists can't be resumed — raise at
+    once instead of re-running a cold start max_retries times."""
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    calls = {"n": 0}
+
+    def always_fail(self, state, xs, ys):
+        calls["n"] += 1
+        raise RuntimeError("deterministic bug")
+
+    monkeypatch.setattr(WindowedEngine, "run_epoch", always_fail)
+    t = dk.DOWNPOUR(_mlp(), num_workers=2, batch_size=16, num_epoch=2,
+                    communication_window=4, checkpoint_dir=str(tmp_path / "empty"))
+    with pytest.raises(RuntimeError, match="deterministic bug"):
+        t.train_with_recovery(df, max_retries=5)
+    assert calls["n"] == 1  # round 1: 1 + max_retries cold-start re-runs
+
+
+def test_recovery_does_not_retry_same_exception_twice(toy_classification, tmp_path, monkeypatch):
+    """After a successful restore, an identical failure signature means the
+    bug is deterministic: raise on the second occurrence."""
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    real_run_epoch = WindowedEngine.run_epoch
+    calls = {"n": 0}
+
+    def flaky(self, state, xs, ys):
+        calls["n"] += 1
+        if calls["n"] >= 2:  # 1st epoch checkpoints, then every epoch fails
+            raise RuntimeError("same shape error")
+        return real_run_epoch(self, state, xs, ys)
+
+    monkeypatch.setattr(WindowedEngine, "run_epoch", flaky)
+    t = dk.DOWNPOUR(_mlp(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                    num_workers=2, batch_size=16, num_epoch=3,
+                    communication_window=4, checkpoint_dir=str(tmp_path))
+    with pytest.raises(RuntimeError, match="same shape error"):
+        t.train_with_recovery(df, max_retries=5)
+    # attempt 1: epoch ok + crash; attempt 2 (resumed): crash again -> stop.
+    assert calls["n"] == 3
+
+
+def test_tensorboard_scalars_written(toy_classification, tmp_path):
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    logdir = tmp_path / "tb"
+    t = dk.DOWNPOUR(_mlp(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                    num_workers=2, batch_size=16, num_epoch=3,
+                    communication_window=4, tensorboard_dir=str(logdir))
+    t.train(df)
+    files = os.listdir(logdir)
+    assert files, "tensorboard_dir is empty after training"
+    # events file (writer available) or the JSONL fallback
+    assert any(f.startswith("events.") or f == "scalars.jsonl" for f in files)
+
+
+def test_scalar_logger_jsonl_fallback(tmp_path, monkeypatch):
+    import builtins
+
+    import distkeras_tpu.utils.tb as tb
+
+    real_import = builtins.__import__
+
+    def no_writers(name, *a, **k):
+        if name.startswith(("torch", "tensorflow")):
+            raise ImportError(name)
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_writers)
+    logger = tb.ScalarLogger(str(tmp_path))
+    logger.log(0, loss=1.5, accuracy=0.5)
+    logger.log(1, loss=1.0, accuracy=0.75)
+    logger.close()
+    import json
+
+    lines = [json.loads(l) for l in open(tmp_path / "scalars.jsonl")]
+    assert lines == [
+        {"step": 0, "loss": 1.5, "accuracy": 0.5},
+        {"step": 1, "loss": 1.0, "accuracy": 0.75},
+    ]
