@@ -15,7 +15,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["epoch_arrays", "plan_epoch"]
+__all__ = ["epoch_arrays", "epoch_window_iter", "plan_epoch"]
 
 
 def plan_epoch(n: int, num_workers: int, batch_size: int, window: int) -> Tuple[int, int]:
@@ -64,3 +64,61 @@ def epoch_arrays(
     xs = xs.reshape(shape + features.shape[1:])
     ys = ys.reshape(shape + labels.shape[1:])
     return xs, ys
+
+
+def epoch_window_iter(
+    features: np.ndarray,
+    labels: np.ndarray,
+    num_workers: int,
+    batch_size: int,
+    window: int,
+    *,
+    rng: Optional[np.random.Generator] = None,
+    pad_to_window: bool = True,
+):
+    """Lazily yield one epoch as per-window blocks
+    ``[num_workers, window, batch, ...]`` — the streaming twin of
+    :func:`epoch_arrays`.
+
+    Draws the identical shuffle from ``rng`` and emits rows in exactly the
+    order ``epoch_arrays`` lays them out (asserted bit-for-bit in
+    tests/test_streaming.py), but gathers only ``num_workers*window*batch``
+    rows at a time, so the whole-epoch array never exists — on host or
+    device.  This is the path for datasets approaching HBM size; the
+    reference's analogue is Spark streaming partitions into executors
+    (SURVEY.md §3.1) rather than collecting the dataset to the driver.
+
+    ``pad_to_window=True`` wrap-pads the step count up to a window multiple
+    (commit semantics need full windows — matches ``epoch_arrays``).  With
+    ``pad_to_window=False`` the step count is planned at step granularity and
+    the final block may be ragged: the right shape for no-commit trainers,
+    where block boundaries are arbitrary and extra padded steps would change
+    the trajectory.
+    """
+    n = len(features)
+    if n == 0:
+        raise ValueError("empty dataset")
+    idx = np.arange(n)
+    if rng is not None:
+        rng.shuffle(idx)
+    if pad_to_window:
+        n_windows, total = plan_epoch(n, num_workers, batch_size, window)
+        steps = n_windows * window
+    else:
+        steps, total = plan_epoch(n, num_workers, batch_size, 1)
+        n_windows = -(-steps // window)
+    reps = -(-total // n)
+    idx = np.tile(idx, reps)[:total]
+    # epoch_arrays reshapes worker-major: worker k / window w covers the flat
+    # slice idx2[k, w*window:(w+1)*window] below.
+    idx2 = idx.reshape(num_workers, steps, batch_size)
+    from distkeras_tpu import native
+
+    for w in range(n_windows):
+        block = idx2[:, w * window : (w + 1) * window]
+        cur = block.shape[1]  # < window only for a ragged final block
+        sel = np.ascontiguousarray(block).ravel()
+        block_shape = (num_workers, cur, batch_size)
+        xs = native.gather_rows(features, sel).reshape(block_shape + features.shape[1:])
+        ys = native.gather_rows(labels, sel).reshape(block_shape + labels.shape[1:])
+        yield xs, ys

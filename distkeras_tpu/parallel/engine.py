@@ -122,8 +122,22 @@ class WindowedEngine:
             self.seq_axis = None
         self.axis = self.mesh.axis_names[0]
         self.both_axes = (VWORKER_AXIS, self.axis)
+        self._rep = replicated_sharding(self.mesh)
+        self._shard = worker_sharding(self.mesh)
+        self._finish_init(
+            loss, worker_optimizer, metrics, compute_dtype,
+            sync_model_state, commit_schedule,
+        )
+
+    def _finish_init(
+        self, loss, worker_optimizer, metrics, compute_dtype,
+        sync_model_state, commit_schedule,
+    ):
+        """Mesh-independent setup shared with subclasses (GSPMDEngine):
+        optimizer/loss/metric resolution and commit-schedule validation.
+        Requires ``self.adapter`` and ``self.num_workers`` to be set."""
         self.optimizer = get_optimizer(worker_optimizer)
-        self.loss_fn = get_loss(loss, from_logits=adapter.outputs_logits)
+        self.loss_fn = get_loss(loss, from_logits=self.adapter.outputs_logits)
         self.metric_fns = [get_metric(m) for m in metrics]
         self.compute_dtype = compute_dtype
         self.sync_model_state = sync_model_state
@@ -137,8 +151,6 @@ class WindowedEngine:
                 f"commit_schedule has {len(self.commit_schedule)} entries for "
                 f"{self.num_workers} workers"
             )
-        self._rep = replicated_sharding(self.mesh)
-        self._shard = worker_sharding(self.mesh)
         self._epoch_fns = {}
 
     # ------------------------------------------------------------------ init
@@ -456,6 +468,67 @@ class WindowedEngine:
                 self._epoch_fns[key] = self._make_epoch_fn(n_windows, window, do_commit, xs.ndim)
         with self.mesh:
             return self._epoch_fns[key](state, xs, ys)
+
+    def run_epoch_streaming(self, state: TrainState, window_iter, prefetch: int = 2):
+        """Run one epoch from a host-side iterator of per-window blocks
+        ``(xs, ys)`` shaped ``[num_workers, window, batch, ...]`` (see
+        :func:`distkeras_tpu.data.epoch_window_iter`).
+
+        The whole-epoch array is never materialised on device: each block is
+        device_put as it's consumed, and because dispatch is asynchronous the
+        next block's host gather + transfer overlaps the current block's
+        compute (double buffering).  Device-resident blocks are bounded at
+        ~2x ``prefetch``: up to ``prefetch`` undispatched blocks wait in the
+        buffer while up to ``prefetch`` dispatched windows are in flight.
+        The per-window program is the n_windows=1 epoch program, so the
+        training trajectory is the math of :meth:`run_epoch` exactly
+        (asserted bit-for-bit in tests/test_streaming.py).
+        """
+        if self.commit_schedule is not None:
+            raise ValueError(
+                "streaming runs uniform windows; the staleness simulation "
+                "needs the whole epoch in one program (run_epoch)"
+            )
+        from collections import deque
+
+        def put(block):
+            xs, ys = block
+            return self.shard_batches(xs[:, None], ys[:, None])
+
+        it = iter(window_iter)
+        buf = deque()
+        for _ in range(max(1, prefetch)):
+            block = next(it, None)
+            if block is None:
+                break
+            buf.append(put(block))
+        losses, mets = [], []
+        n_windows = 0
+        depth = max(1, prefetch)
+        while buf:
+            xs, ys = buf.popleft()
+            state, stats = self.run_epoch(state, xs, ys)  # async dispatch
+            n_windows += 1
+            losses.append(stats["loss"])
+            mets.append(stats["metrics"])
+            # Backpressure: dispatch is async, so without a sync the host
+            # would device_put the whole epoch ahead of the device and defeat
+            # the memory bound.  Waiting on the loss of the window dispatched
+            # `prefetch` calls ago caps in-flight windows at prefetch (plus
+            # up to prefetch buffered undispatched blocks — see docstring).
+            if n_windows > depth:
+                jax.block_until_ready(losses[n_windows - 1 - depth])
+            block = next(it, None)
+            if block is not None:
+                buf.append(put(block))
+        if not losses:
+            raise ValueError("empty window iterator")
+        stats = {"loss": jnp.concatenate(losses), "metrics": jnp.concatenate(mets)}
+        # each window ran as its own "epoch" program (epoch += n_windows);
+        # restore whole-epoch semantics (+1).  The input state was donated by
+        # the first window's call, so arithmetic uses the live output state.
+        state = state.replace(epoch=state.epoch - (n_windows - 1))
+        return state, stats
 
     def average_workers(self, state: TrainState):
         """One-shot synchronous weight average (AveragingTrainer's final step)."""
