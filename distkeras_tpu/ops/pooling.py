@@ -34,9 +34,13 @@ def max_pool(
     Takes the reshape fast path when windows are non-overlapping
     (``strides == window_shape``), padding is VALID, and every pooled spatial
     dim divides evenly; falls back to ``flax.linen.max_pool`` otherwise.
-    Forward numerics are identical in every case; the fast path's gradient
-    differs from select-and-scatter only when a window holds exact ties
-    (measure-zero for continuous activations).
+    Forward numerics are identical in every case.  Gradients differ when a
+    window holds exact ties: the fast path distributes the tie's gradient
+    evenly across the tied positions, while select-and-scatter picks a single
+    winner.  Both are valid subgradients of max, but ties are *common* in
+    practice — these layers pool post-ReLU feature maps, where exact zeros
+    carry large probability mass — so training trajectories can differ from
+    the flax path routinely, not just on a measure-zero set.
     """
     window_shape = tuple(window_shape)
     strides = window_shape if strides is None else tuple(strides)

@@ -75,8 +75,6 @@ class GSPMDEngine(WindowedEngine):
         commit_schedule: Optional[np.ndarray] = None,
         devices: Optional[Sequence] = None,
     ):
-        from distkeras_tpu.ops import get_loss, get_metric, get_optimizer
-
         devices = list(devices if devices is not None else jax.devices())
         self.tp_shards = int(tp_shards)
         if len(devices) % self.tp_shards:
@@ -103,22 +101,12 @@ class GSPMDEngine(WindowedEngine):
         # splits it across the mesh axis by sharding propagation), so the
         # commit rules' psum reduces over just the vmap axis name.
         self.both_axes = (VWORKER_AXIS,)
-        self.optimizer = get_optimizer(worker_optimizer)
-        self.loss_fn = get_loss(loss, from_logits=adapter.outputs_logits)
-        self.metric_fns = [get_metric(m) for m in metrics]
-        self.compute_dtype = compute_dtype
-        self.sync_model_state = sync_model_state
-        self.commit_schedule = (
-            None if commit_schedule is None else np.asarray(commit_schedule, np.int32)
-        )
-        if self.commit_schedule is not None and len(self.commit_schedule) != self.num_workers:
-            raise ValueError(
-                f"commit_schedule has {len(self.commit_schedule)} entries for "
-                f"{self.num_workers} workers"
-            )
         self._rep = NamedSharding(self.mesh, P())
         self._shard = NamedSharding(self.mesh, P(WORKER_AXIS))
-        self._epoch_fns = {}
+        self._finish_init(
+            loss, worker_optimizer, metrics, compute_dtype,
+            sync_model_state, commit_schedule,
+        )
 
     # ------------------------------------------------------------- shardings
     def _tp_spec(self, shape) -> P:
@@ -206,15 +194,16 @@ class GSPMDEngine(WindowedEngine):
                 )
                 # psum over the vmap axis makes every worker's center copy
                 # identical; collapse the stacked dim and re-pin the TP
-                # sharding so the scan carry stays partitioned.
+                # sharding so the scan carry stays partitioned.  The whole
+                # local tuple is re-pinned: opt_state and rule_local carry
+                # param-shaped leaves as large as the params themselves, and
+                # an unconstrained carry would let the partitioner replicate
+                # them across the model axis.
                 center_params = self._constrain_center(
                     jax.tree.map(lambda x: x[0], centers_p)
                 )
                 center_rule = jax.tree.map(lambda x: x[0], centers_r)
-                local = (
-                    self._constrain_worker(local[0]),  # local_params
-                    local[1], local[2], local[3], local[4],
-                )
+                local = self._constrain_worker(local)
                 return (center_params, center_rule, local), (loss, mets)
 
             (center_params, center_rule, local), (losses, mets) = lax.scan(
@@ -270,8 +259,7 @@ class GSPMDEngine(WindowedEngine):
                     jax.tree.map(lambda x: x[0], centers_p)
                 )
                 center_rule = jax.tree.map(lambda x: x[0], centers_r)
-                local = (self._constrain_worker(local[0]),
-                         local[1], local[2], local[3], local[4])
+                local = self._constrain_worker(local)  # see windowed epoch fn
                 return (center_params, center_rule, local, since), loss
 
             since0 = jnp.zeros((self.num_workers,), jnp.int32)
