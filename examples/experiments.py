@@ -1,0 +1,134 @@
+"""Per-trainer accuracy/time experiment table — the rebuild's equivalent of
+the reference README's MNIST experiments section (SURVEY.md §6).
+
+Runs the full DataFrame pipeline (transformers -> trainer -> predictor ->
+evaluator) for SingleTrainer and all five async algorithms at their
+reference-default communication windows, and prints a markdown table.  The
+measured copy of this table lives in README.md; a floor-asserting regression
+version runs as tests/test_experiment_table.py.
+
+Run:  python examples/experiments.py [--workers N] [--epochs E] [--markdown]
+      (add --cpu 8 to run on a faked 8-device CPU mesh, no TPU needed)
+
+Dataset: ``keras.datasets.mnist`` when cached locally, else scikit-learn's
+bundled 8x8 digits (offline-friendly, same pipeline).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def load_dataset():
+    # Only use MNIST when the archive is already cached: load_data() would
+    # otherwise try to download, which hangs in offline environments.
+    cache = os.path.expanduser("~/.keras/datasets/mnist.npz")
+    if os.path.exists(cache):
+        with np.load(cache) as d:
+            x, y = d["x_train"], d["y_train"]
+        x = x.reshape(len(x), -1).astype(np.float32)
+        return "mnist", x, y.astype(np.int32), 255.0
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    return "digits", d.data.astype(np.float32), d.target.astype(np.int32), 16.0
+
+
+def run_experiments(num_workers=None, epochs=10, batch_size=32, seed=0):
+    """Train every trainer family on the same split; returns
+    ``(dataset_name, {trainer: (accuracy, seconds)})``."""
+    import jax
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import MLP, FlaxModel
+
+    num_workers = num_workers or jax.device_count()
+    name, x, y, max_val = load_dataset()
+
+    df = dk.from_numpy(x, y, features_col="features_raw", label_col="label")
+    df = dk.MinMaxTransformer(0.0, 1.0, 0.0, max_val,
+                              input_col="features_raw",
+                              output_col="features").transform(df)
+    df = dk.OneHotTransformer(10, input_col="label",
+                              output_col="label_encoded").transform(df)
+    train_df, test_df = df.split(0.8, seed=0)
+
+    def fresh_model():
+        return FlaxModel(MLP(features=(256, 128), num_classes=10))
+
+    def evaluate(trained) -> float:
+        pred = dk.ModelPredictor(trained, features_col="features").predict(test_df)
+        pred = dk.LabelIndexTransformer(10, input_col="prediction",
+                                        output_col="prediction_index").transform(pred)
+        return dk.AccuracyEvaluator(prediction_col="prediction_index",
+                                    label_col="label").evaluate(pred)
+
+    common = dict(loss="categorical_crossentropy",
+                  features_col="features", label_col="label_encoded",
+                  batch_size=batch_size, num_epoch=epochs, seed=seed)
+    # Adaptive worker optimizer, matched across trainers: unnormalised
+    # windowed-delta sums (DOWNPOUR/DynSGD) diverge under plain SGD as worker
+    # count grows — the very instability ADAG's window normalisation was
+    # invented to fix (arXiv:1710.02368) — and the reference's own mnist
+    # example reached for adagrad for the same reason.
+    adam = ("adam", {"learning_rate": 1e-3})
+    results = {}
+
+    trainer = dk.SingleTrainer(fresh_model(), worker_optimizer=adam, **common)
+    results["SingleTrainer"] = (evaluate(trainer.train(train_df)),
+                                trainer.get_training_time())
+
+    # Reference-default communication windows (SURVEY.md §2 trainer configs).
+    async_trainers = [
+        ("DOWNPOUR", dk.DOWNPOUR, {"worker_optimizer": adam, "communication_window": 5}),
+        ("AEASGD", dk.AEASGD, {"worker_optimizer": adam, "communication_window": 32,
+                               "rho": 1.0, "learning_rate": 0.05}),
+        ("EAMSGD", dk.EAMSGD, {"communication_window": 32, "rho": 1.0,
+                               "learning_rate": 0.05, "momentum": 0.9}),
+        ("ADAG", dk.ADAG, {"worker_optimizer": adam, "communication_window": 12}),
+        ("DynSGD", dk.DynSGD, {"worker_optimizer": adam, "communication_window": 5}),
+    ]
+    for trainer_name, cls, kw in async_trainers:
+        trainer = cls(fresh_model(), num_workers=num_workers, **common, **kw)
+        results[trainer_name] = (evaluate(trainer.train(train_df)),
+                                 trainer.get_training_time())
+    return name, results
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--workers", type=int, default=None)
+    parser.add_argument("--epochs", type=int, default=10)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--markdown", action="store_true")
+    parser.add_argument("--cpu", type=int, default=0, metavar="N",
+                        help="force an N-device CPU mesh (offline / no TPU)")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
+
+    name, results = run_experiments(args.workers, args.epochs, args.batch_size)
+    backend = jax.default_backend()
+    n_dev = jax.device_count()
+    print(f"\ndataset={name}, backend={backend} x{n_dev}, epochs={args.epochs}")
+    if args.markdown:
+        print("| trainer | accuracy | time (s) |")
+        print("|---|---|---|")
+        for trainer_name, (acc, t) in results.items():
+            print(f"| {trainer_name} | {acc:.4f} | {t:.1f} |")
+    else:
+        print(f"{'trainer':<16} {'accuracy':>9} {'time (s)':>9}")
+        for trainer_name, (acc, t) in results.items():
+            print(f"{trainer_name:<16} {acc:>9.4f} {t:>9.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,17 +22,18 @@ import numpy as np
 
 
 def load_dataset():
-    try:
-        import keras
-
-        (x, y), _ = keras.datasets.mnist.load_data()
+    # Only use MNIST when the archive is already cached: load_data() would
+    # otherwise try to download, which hangs in offline environments.
+    cache = os.path.expanduser("~/.keras/datasets/mnist.npz")
+    if os.path.exists(cache):
+        with np.load(cache) as d:
+            x, y = d["x_train"], d["y_train"]
         x = x.reshape(len(x), -1).astype(np.float32)
         return x, y.astype(np.int32), 255.0, (28, 28, 1)
-    except Exception:
-        from sklearn.datasets import load_digits
+    from sklearn.datasets import load_digits
 
-        d = load_digits()
-        return d.data.astype(np.float32), d.target.astype(np.int32), 16.0, (8, 8, 1)
+    d = load_digits()
+    return d.data.astype(np.float32), d.target.astype(np.int32), 16.0, (8, 8, 1)
 
 
 def main():
