@@ -22,7 +22,10 @@ from typing import Callable, Dict, Iterable, Iterator, List, Mapping, Sequence, 
 
 import numpy as np
 
-__all__ = ["DataFrame", "Row", "from_rows", "from_numpy", "from_pandas", "read_csv"]
+__all__ = [
+    "DataFrame", "Row", "from_rows", "from_numpy", "from_pandas",
+    "from_spark", "read_csv",
+]
 
 
 class Row(dict):
@@ -262,6 +265,42 @@ def from_numpy(
 
 def from_pandas(pdf, num_partitions: int = 1) -> DataFrame:
     return DataFrame({c: _as_column(pdf[c].to_list()) for c in pdf.columns}, num_partitions)
+
+
+def from_spark(sdf, columns: Sequence[str] | None = None) -> DataFrame:
+    """Bridge a **pyspark** DataFrame into the columnar frame.
+
+    The reference lived natively on Spark DataFrames; users migrating actual
+    Spark pipelines call this once at the boundary
+    (``dk.from_spark(spark_df)``) and keep the rest of the flow unchanged.
+    Spark ML vector values (``DenseVector``/``SparseVector`` — the
+    features/label columns the reference's transformers produce) are
+    densified via their ``toArray``.  Prefers ``toPandas()`` (Arrow fast
+    path) and falls back to ``collect()``; partitioning metadata carries
+    over from ``rdd.getNumPartitions()`` when available.
+
+    pyspark itself is NOT a dependency: this function only touches the
+    object it's handed.
+    """
+    names = list(columns) if columns is not None else list(sdf.columns)
+
+    def densify(v):
+        return np.asarray(v.toArray(), np.float32) if hasattr(v, "toArray") else v
+
+    try:
+        pdf = sdf.toPandas()  # ONLY the transfer is fallible-by-design
+    except Exception:
+        pdf = None
+    if pdf is not None:
+        data = {c: [densify(v) for v in pdf[c].to_list()] for c in names}
+    else:
+        rows = sdf.collect()
+        data = {c: [densify(r[c]) for r in rows] for c in names}
+    try:
+        num_partitions = int(sdf.rdd.getNumPartitions())
+    except Exception:
+        num_partitions = 1
+    return DataFrame({c: _as_column(v) for c, v in data.items()}, num_partitions)
 
 
 def read_csv(path: str, header: bool = True, num_partitions: int = 1) -> DataFrame:

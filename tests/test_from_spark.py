@@ -1,0 +1,110 @@
+"""from_spark ingestion bridge (VERDICT r1 item 9, SURVEY §7 design stance:
+DataFrame facade "with an optional pyspark adapter").
+
+pyspark isn't installed in CI, so the adapter's logic is exercised against a
+duck-typed stand-in implementing the same surface (columns / toPandas /
+collect / rdd.getNumPartitions, with Spark-ML-style vector values exposing
+toArray); a real-pyspark round-trip runs when pyspark is importable."""
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.frame import from_spark
+
+
+class _FakeVector:
+    """Duck-type of pyspark.ml.linalg.DenseVector."""
+
+    def __init__(self, values):
+        self._values = list(values)
+
+    def toArray(self):
+        return np.asarray(self._values)
+
+
+class _FakeRDD:
+    def __init__(self, n):
+        self._n = n
+
+    def getNumPartitions(self):
+        return self._n
+
+
+class _FakeSparkDF:
+    """Duck-type of the pyspark.sql.DataFrame surface from_spark touches."""
+
+    def __init__(self, rows, partitions=3, pandas_ok=True):
+        self._rows = rows
+        self.columns = list(rows[0].keys())
+        self.rdd = _FakeRDD(partitions)
+        self._pandas_ok = pandas_ok
+
+    def toPandas(self):
+        if not self._pandas_ok:
+            raise RuntimeError("Arrow unavailable")
+        import pandas as pd
+
+        return pd.DataFrame(self._rows)
+
+    def collect(self):
+        return self._rows
+
+
+def _rows(n=6):
+    return [
+        {"features": _FakeVector([float(i), float(i) + 0.5]),
+         "label": i % 2,
+         "name": f"row{i}"}
+        for i in range(n)
+    ]
+
+
+@pytest.mark.parametrize("pandas_ok", [True, False])  # Arrow path and collect fallback
+def test_from_spark_densifies_vectors(pandas_ok):
+    df = from_spark(_FakeSparkDF(_rows(), pandas_ok=pandas_ok))
+    assert df.columns == ["features", "label", "name"]
+    assert len(df) == 6
+    assert df.num_partitions == 3
+    feats = df.matrix("features")
+    np.testing.assert_allclose(feats[:, 1] - feats[:, 0], 0.5)
+    assert list(df.column("label")) == [0, 1, 0, 1, 0, 1]
+
+
+def test_from_spark_column_subset():
+    df = from_spark(_FakeSparkDF(_rows()), columns=["features", "label"])
+    assert df.columns == ["features", "label"]
+
+
+def test_from_spark_feeds_training():
+    df = from_spark(_FakeSparkDF(_rows(64)))
+    df = dk.OneHotTransformer(2, input_col="label",
+                              output_col="label_encoded").transform(df)
+    from distkeras_tpu.models import MLP, FlaxModel
+
+    t = dk.SingleTrainer(FlaxModel(MLP(features=(8,), num_classes=2)),
+                         loss="categorical_crossentropy",
+                         worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                         features_col="features", label_col="label_encoded",
+                         batch_size=8, num_epoch=1)
+    trained = t.train(df)
+    assert trained.predict(df.matrix("features")).shape == (64, 2)
+
+
+def test_from_spark_real_pyspark_roundtrip():
+    pyspark = pytest.importorskip("pyspark")
+    from pyspark.ml.linalg import Vectors
+    from pyspark.sql import SparkSession
+
+    spark = SparkSession.builder.master("local[1]").getOrCreate()
+    try:
+        sdf = spark.createDataFrame(
+            [(Vectors.dense([1.0, 2.0]), 0), (Vectors.dense([3.0, 4.0]), 1)],
+            ["features", "label"],
+        )
+        df = from_spark(sdf)
+        np.testing.assert_allclose(df.matrix("features"),
+                                   [[1.0, 2.0], [3.0, 4.0]])
+        assert list(df.column("label")) == [0, 1]
+    finally:
+        spark.stop()
