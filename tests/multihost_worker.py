@@ -2,46 +2,68 @@
 
 Launched as N separate processes by test_multihost.py; each joins the
 jax.distributed coordination service (the reference's master host:port
-handshake), contributes 4 faked CPU devices, and runs DOWNPOUR over the
+handshake), contributes its faked CPU devices, and trains DOWNPOUR over the
 global 8-device mesh — commits ride the cross-process collective path (the
-DCN analogue).
+DCN analogue).  ``engine=windowed`` runs the shard_map engine over a 1-D
+workers mesh; ``engine=gspmd`` runs the pjit engine over a 2-D
+(workers, model) mesh, so tensor-parallel sharding propagation is exercised
+across process boundaries too.
 """
 
 import sys
 
 
-def main(coordinator: str, num_processes: int, process_id: int) -> None:
+def main(coordinator: str, num_processes: int, process_id: int,
+         engine_kind: str = "windowed") -> None:
     import jax
 
+    devices_per_proc = 8 // num_processes
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    jax.config.update("jax_num_cpu_devices", devices_per_proc)
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
         process_id=process_id,
     )
-    assert jax.device_count() == 4 * num_processes, jax.device_count()
-    assert jax.local_device_count() == 4
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == devices_per_proc
 
     import numpy as np
 
     from distkeras_tpu.algorithms import Downpour
     from distkeras_tpu.models import MLP, FlaxModel
-    from distkeras_tpu.parallel.engine import WindowedEngine
 
-    engine = WindowedEngine(
-        FlaxModel(MLP(features=(16,), num_classes=2)),
-        "categorical_crossentropy",
-        ("sgd", {"learning_rate": 0.1}),
-        Downpour(communication_window=2),
-        num_workers=jax.device_count(),
-    )
+    if engine_kind == "gspmd":
+        from distkeras_tpu.parallel.gspmd import GSPMDEngine
+
+        num_workers = 4  # (workers=4, model=2) grid over the 8 devices
+        engine = GSPMDEngine(
+            FlaxModel(MLP(features=(16,), num_classes=2)),
+            "categorical_crossentropy",
+            ("sgd", {"learning_rate": 0.1}),
+            Downpour(communication_window=2),
+            num_workers=num_workers,
+            tp_shards=2,
+        )
+    else:
+        from distkeras_tpu.parallel.engine import WindowedEngine
+
+        num_workers = 8
+        engine = WindowedEngine(
+            FlaxModel(MLP(features=(16,), num_classes=2)),
+            "categorical_crossentropy",
+            ("sgd", {"learning_rate": 0.1}),
+            Downpour(communication_window=2),
+            num_workers=num_workers,
+        )
+
     rng = np.random.default_rng(0)  # same data on every process (SPMD)
     x = rng.normal(size=(512, 8)).astype(np.float32)
     y = (x @ rng.normal(size=(8,)) > 0).astype(np.int32)
     onehot = np.eye(2, dtype=np.float32)[y]
-    xs = x.reshape(8, 2, 2, 16, 8)
-    ys = onehot.reshape(8, 2, 2, 16, 2)
+    batch = 512 // (num_workers * 2 * 2)
+    xs = x.reshape(num_workers, 2, 2, batch, 8)
+    ys = onehot.reshape(num_workers, 2, 2, batch, 2)
 
     state = engine.init_state(jax.random.PRNGKey(0), x[:16])
     xs_d, ys_d = engine.shard_batches(xs, ys)
@@ -50,10 +72,12 @@ def main(coordinator: str, num_processes: int, process_id: int) -> None:
         state, stats = engine.run_epoch(state, xs_d, ys_d)
         losses.append(float(np.mean(np.asarray(stats["loss"]))))
     assert losses[-1] < losses[0], losses
-    assert int(np.asarray(state.center_rule["num_updates"])) == 8 * 2 * 6
-    print(f"process {process_id}: ok, losses {losses[0]:.3f}->{losses[-1]:.3f}")
+    assert int(np.asarray(state.center_rule["num_updates"])) == num_workers * 2 * 6
+    print(f"process {process_id}: ok ({engine_kind}), "
+          f"losses {losses[0]:.3f}->{losses[-1]:.3f}")
     jax.distributed.shutdown()
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+         sys.argv[4] if len(sys.argv) > 4 else "windowed")

@@ -1,11 +1,12 @@
 """Multi-host (multi-process) integration: the DCN-path smoke test.
 
-Spawns two OS processes that join a jax.distributed coordination service and
+Spawns N OS processes that join a jax.distributed coordination service and
 train DOWNPOUR over the combined 8-device mesh — the same engine code path
 that spans TPU pod slices (ICI in-slice, DCN across), exercised on one
 machine the way the reference exercised its cluster protocol under Spark
-local mode (SURVEY.md §4).
-"""
+local mode (SURVEY.md §4).  Covers 2- and 4-process topologies and both
+engines (shard_map windowed; GSPMD tensor-parallel over a 2-D mesh whose
+model axis spans processes)."""
 
 import os
 import socket
@@ -23,23 +24,23 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.slow
-def test_two_process_downpour():
+def _run_processes(num_processes: int, engine_kind: str, timeout: int = 300):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = os.path.join(repo, "tests", "multihost_worker.py")
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {**os.environ, "PYTHONPATH": repo}
     procs = [
         subprocess.Popen(
-            [sys.executable, script, coordinator, "2", str(i)],
+            [sys.executable, script, coordinator, str(num_processes), str(i),
+             engine_kind],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
         )
-        for i in range(2)
+        for i in range(num_processes)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -47,4 +48,20 @@ def test_two_process_downpour():
         pytest.fail("multi-host processes timed out\n" + "\n".join(outs))
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
-        assert f"process {i}: ok" in out
+        assert f"process {i}: ok ({engine_kind})" in out
+
+
+@pytest.mark.slow
+def test_two_process_downpour():
+    _run_processes(2, "windowed")
+
+
+@pytest.mark.slow
+def test_four_process_downpour():
+    _run_processes(4, "windowed")
+
+
+@pytest.mark.slow
+def test_four_process_gspmd_tensor_parallel():
+    # model axis (tp=2) and worker axis both cross process boundaries
+    _run_processes(4, "gspmd")
