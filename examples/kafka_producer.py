@@ -1,0 +1,91 @@
+"""Standalone feature-batch producer — the reference's ``kafka_producer.py``
+companion script for the high-throughput inference pipeline.
+
+Two transports:
+
+* **kafka** (``--bootstrap-servers host:9092 --topic features``): publishes
+  npz-encoded batches through ``kafka-python`` when it's installed — the
+  reference's original transport, unchanged.
+* **tcp** (default): serves batches over a plain socket with the package's
+  own length-prefixed codec (``distkeras_tpu.networking.send_data`` — no
+  pickle), so the producer/consumer split is demonstrable across real
+  processes with zero external infrastructure:
+
+      terminal 1:  python examples/kafka_producer.py --port 9092
+      terminal 2:  python examples/streaming_inference.py --source tcp://127.0.0.1:9092
+
+End-of-stream markers: the TCP transport sends a codec-encoded ``None``;
+the Kafka transport publishes one empty message (``b""``) — check for an
+empty payload in a kafka-python consumer.
+"""
+
+import argparse
+import os
+import socket
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def batches(n_batches: int, rows: int, features: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n_batches):
+        yield rng.normal(size=(rows, features)).astype(np.float32)
+
+
+def produce_tcp(port: int, n_batches: int, rows: int, features: int) -> None:
+    from distkeras_tpu.networking import send_data
+
+    server = socket.socket()
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("0.0.0.0", port))
+    server.listen(1)
+    print(f"producer: serving {n_batches} x {rows} rows on :{port} ...")
+    conn, addr = server.accept()
+    print(f"producer: consumer connected from {addr[0]}")
+    sent = 0
+    with conn:
+        for batch in batches(n_batches, rows, features):
+            send_data(conn, batch)
+            sent += len(batch)
+        send_data(conn, None)  # end-of-stream
+    server.close()
+    print(f"producer: done, {sent} rows")
+
+
+def produce_kafka(bootstrap: str, topic: str, n_batches: int, rows: int, features: int) -> None:
+    import io
+
+    from kafka import KafkaProducer  # the reference's transport
+
+    producer = KafkaProducer(bootstrap_servers=bootstrap)
+    for batch in batches(n_batches, rows, features):
+        buf = io.BytesIO()
+        np.save(buf, batch)
+        producer.send(topic, buf.getvalue())
+    producer.send(topic, b"")  # end-of-stream
+    producer.flush()
+    print(f"producer: published {n_batches} batches to {topic}")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=9092)
+    parser.add_argument("--batches", type=int, default=200)
+    parser.add_argument("--rows", type=int, default=1024)
+    parser.add_argument("--features", type=int, default=32)
+    parser.add_argument("--bootstrap-servers", default=None,
+                        help="use a real Kafka cluster (needs kafka-python)")
+    parser.add_argument("--topic", default="features")
+    args = parser.parse_args()
+    if args.bootstrap_servers:
+        produce_kafka(args.bootstrap_servers, args.topic,
+                      args.batches, args.rows, args.features)
+    else:
+        produce_tcp(args.port, args.batches, args.rows, args.features)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,13 +2,16 @@
 notebook (``examples/`` Kafka producer + inference consumer) without the
 Kafka dependency.
 
-A producer thread emits feature batches onto a queue (stand-in for a Kafka
-topic; swap in ``kafka-python`` consumers unchanged — the prediction loop only
-sees an iterator of batches).  The consumer drains batches, runs the jitted
-model forward pass, and appends predictions to a result DataFrame, reporting
-sustained rows/sec.
+Default: a producer thread emits feature batches onto a queue (stand-in for
+a Kafka topic; swap in ``kafka-python`` consumers unchanged — the prediction
+loop only sees an iterator of batches).  With ``--source tcp://host:port``
+the consumer instead drains a *separate producer process*
+(``examples/kafka_producer.py``) over the package wire codec — the real
+cross-process pipeline.  Either way the consumer runs the jitted model
+forward pass per batch and reports sustained rows/sec.
 """
 
+import argparse
 import os
 import queue
 import sys
@@ -20,7 +23,28 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 
+def tcp_batches(addr: str):
+    """Yield batches from a kafka_producer.py --port serving at tcp://host:port."""
+    from distkeras_tpu.networking import connect, recv_data
+
+    host, port = addr.removeprefix("tcp://").rsplit(":", 1)
+    sock = connect(host, int(port))
+    try:
+        while True:
+            batch = recv_data(sock)
+            if batch is None:
+                return
+            yield batch
+    finally:
+        sock.close()
+
+
 def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--source", default=None,
+                        help="tcp://host:port of a running kafka_producer.py "
+                             "(default: in-process producer thread)")
+    args = parser.parse_args()
     import distkeras_tpu as dk
     from distkeras_tpu.models import MLP, FlaxModel
     from distkeras_tpu.predictors import ModelPredictor
@@ -39,23 +63,24 @@ def main():
                                num_epoch=3).train(df)
     predictor = ModelPredictor(trained, batch_size=1024)
 
-    # "Kafka topic": a bounded queue fed by a producer thread.
-    topic: "queue.Queue" = queue.Queue(maxsize=64)
-    n_batches, batch_rows = 200, 1024
+    if args.source:
+        stream = tcp_batches(args.source)
+    else:
+        # "Kafka topic": a bounded queue fed by a producer thread.
+        topic: "queue.Queue" = queue.Queue(maxsize=64)
+        n_batches, batch_rows = 200, 1024
 
-    def producer():
-        for _ in range(n_batches):
-            topic.put(rng.normal(size=(batch_rows, 32)).astype(np.float32))
-        topic.put(None)  # end-of-stream marker
+        def producer():
+            for _ in range(n_batches):
+                topic.put(rng.normal(size=(batch_rows, 32)).astype(np.float32))
+            topic.put(None)  # end-of-stream marker
 
-    threading.Thread(target=producer, daemon=True).start()
+        threading.Thread(target=producer, daemon=True).start()
+        stream = iter(topic.get, None)
 
     rows = 0
     t0 = time.perf_counter()
-    while True:
-        batch = topic.get()
-        if batch is None:
-            break
+    for batch in stream:
         out = predictor.predict(dk.from_numpy(batch))
         rows += len(out)
     dt = time.perf_counter() - t0

@@ -90,3 +90,52 @@ def test_job_bad_secret_denied(punchcard):
     job = Job("127.0.0.1", punchcard.port, secret="wrong", script="print(1)")
     with pytest.raises(RuntimeError):
         job.submit()
+
+
+def test_kafka_producer_tcp_stream():
+    """The standalone producer script (examples/kafka_producer.py) streams
+    batches to a consumer in another process over the package wire codec —
+    the reference Kafka-pipeline split, demonstrable without Kafka."""
+    import os
+    import socket as socket_mod
+    import subprocess
+    import sys
+    import time
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "examples", "kafka_producer.py"),
+         "--port", str(port), "--batches", "5", "--rows", "64", "--features", "8"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    try:
+        sys.path.insert(0, os.path.join(repo, "examples"))
+        from streaming_inference import tcp_batches
+
+        deadline = time.time() + 60
+        batches = None
+        while time.time() < deadline:
+            try:
+                # Retry only the pre-connect phase: the producer accepts a
+                # single consumer, so a post-connect transport error must
+                # propagate rather than be retried into ConnectionRefused.
+                batches = list(tcp_batches(f"tcp://127.0.0.1:{port}"))
+                break
+            except ConnectionRefusedError:
+                time.sleep(0.5)
+        assert batches is not None, "could not connect to producer"
+        assert len(batches) == 5
+        assert all(isinstance(b, np.ndarray) and b.shape == (64, 8) for b in batches)
+        out, _ = proc.communicate(timeout=30)
+        assert proc.returncode == 0, out
+        assert "done, 320 rows" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
