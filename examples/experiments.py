@@ -23,31 +23,23 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 
-def load_dataset():
-    # Only use MNIST when the archive is already cached: load_data() would
-    # otherwise try to download, which hangs in offline environments.
-    cache = os.path.expanduser("~/.keras/datasets/mnist.npz")
-    if os.path.exists(cache):
-        with np.load(cache) as d:
-            x, y = d["x_train"], d["y_train"]
-        x = x.reshape(len(x), -1).astype(np.float32)
-        return "mnist", x, y.astype(np.int32), 255.0
-    from sklearn.datasets import load_digits
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-    d = load_digits()
-    return "digits", d.data.astype(np.float32), d.target.astype(np.int32), 16.0
+from mnist import load_dataset  # noqa: E402 — shared cached-MNIST/digits loader
 
 
-def run_experiments(num_workers=None, epochs=10, batch_size=32, seed=0):
+def run_experiments(num_workers=None, epochs=10, batch_size=32, seed=0,
+                    force_digits=False):
     """Train every trainer family on the same split; returns
-    ``(dataset_name, {trainer: (accuracy, seconds)})``."""
+    ``(dataset_name, {trainer: (accuracy, seconds)})``.  ``force_digits``
+    pins the offline dataset so results don't depend on a cached MNIST."""
     import jax
 
     import distkeras_tpu as dk
     from distkeras_tpu.models import MLP, FlaxModel
 
     num_workers = num_workers or jax.device_count()
-    name, x, y, max_val = load_dataset()
+    name, x, y, max_val, _img_shape = load_dataset(force_digits=force_digits)
 
     df = dk.from_numpy(x, y, features_col="features_raw", label_col="label")
     df = dk.MinMaxTransformer(0.0, 1.0, 0.0, max_val,

@@ -21,19 +21,25 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 
-def load_dataset():
-    # Only use MNIST when the archive is already cached: load_data() would
-    # otherwise try to download, which hangs in offline environments.
+def load_dataset(force_digits: bool = False):
+    """Returns (name, features, labels, max_value, image_shape).
+
+    Only uses MNIST when the archive is already cached: ``load_data()`` would
+    otherwise try to download, which hangs in offline environments.
+    ``force_digits`` pins the scikit-learn fallback regardless of cache state
+    (tests need machine-independent data).
+    """
     cache = os.path.expanduser("~/.keras/datasets/mnist.npz")
-    if os.path.exists(cache):
+    if not force_digits and os.path.exists(cache):
         with np.load(cache) as d:
             x, y = d["x_train"], d["y_train"]
         x = x.reshape(len(x), -1).astype(np.float32)
-        return x, y.astype(np.int32), 255.0, (28, 28, 1)
+        return "mnist", x, y.astype(np.int32), 255.0, (28, 28, 1)
     from sklearn.datasets import load_digits
 
     d = load_digits()
-    return d.data.astype(np.float32), d.target.astype(np.int32), 16.0, (8, 8, 1)
+    return ("digits", d.data.astype(np.float32), d.target.astype(np.int32),
+            16.0, (8, 8, 1))
 
 
 def main():
@@ -49,7 +55,7 @@ def main():
     from distkeras_tpu.models import MLP, FlaxModel
 
     num_workers = args.workers or jax.device_count()
-    x, y, max_val, img_shape = load_dataset()
+    _, x, y, max_val, img_shape = load_dataset()
     num_features = x.shape[1]
     print(f"dataset: {len(x)} samples, {num_features} features, "
           f"{num_workers} workers on {jax.default_backend()}")

@@ -4,11 +4,13 @@ a change that silently degrades any algorithm's convergence fails CI.
 Floors sit ~0.04 under the measured values (README table) to absorb
 backend-level numeric drift; bit-level determinism is covered elsewhere."""
 
+import os
 import sys
 
 import pytest
 
-sys.path.insert(0, "examples")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "examples"))
 
 from experiments import run_experiments
 
@@ -25,7 +27,10 @@ FLOORS = {
 
 @pytest.mark.slow
 def test_every_trainer_meets_accuracy_floor():
-    dataset, results = run_experiments(num_workers=8, epochs=10)
+    # force_digits: the floors were measured on digits; a machine with a
+    # cached MNIST must not silently swap the dataset under the test
+    dataset, results = run_experiments(num_workers=8, epochs=10, force_digits=True)
+    assert dataset == "digits"
     assert set(results) == set(FLOORS)
     failures = {
         name: (acc, FLOORS[name])
