@@ -97,6 +97,7 @@ class WindowedEngine:
         sync_model_state: bool = True,
         mesh=None,
         seq_shards: int = 1,
+        remat: bool = False,
     ):
         self.adapter = adapter
         self.rule = rule
@@ -126,12 +127,12 @@ class WindowedEngine:
         self._shard = worker_sharding(self.mesh)
         self._finish_init(
             loss, worker_optimizer, metrics, compute_dtype,
-            sync_model_state, commit_schedule,
+            sync_model_state, commit_schedule, remat,
         )
 
     def _finish_init(
         self, loss, worker_optimizer, metrics, compute_dtype,
-        sync_model_state, commit_schedule,
+        sync_model_state, commit_schedule, remat=False,
     ):
         """Mesh-independent setup shared with subclasses (GSPMDEngine):
         optimizer/loss/metric resolution and commit-schedule validation.
@@ -140,6 +141,10 @@ class WindowedEngine:
         self.loss_fn = get_loss(loss, from_logits=self.adapter.outputs_logits)
         self.metric_fns = [get_metric(m) for m in metrics]
         self.compute_dtype = compute_dtype
+        # Rematerialise the forward pass on the backward (jax.checkpoint):
+        # trades FLOPs for activation memory — the HBM lever for deep models
+        # (ResNet-scale+) whose per-window activations outgrow the chip.
+        self.remat = bool(remat)
         self.sync_model_state = sync_model_state
         # Per-worker commit periods (staleness simulation).  None => uniform
         # synchronous windows, one collective per window.
@@ -224,6 +229,8 @@ class WindowedEngine:
             )
             return loss, (new_ms, mets)
 
+        if self.remat:
+            compute_loss = jax.checkpoint(compute_loss)
         (loss, (model_state, mets)), grads = jax.value_and_grad(compute_loss, has_aux=True)(
             params, model_state
         )

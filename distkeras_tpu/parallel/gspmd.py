@@ -74,6 +74,7 @@ class GSPMDEngine(WindowedEngine):
         sync_model_state: bool = True,
         commit_schedule: Optional[np.ndarray] = None,
         devices: Optional[Sequence] = None,
+        remat: bool = False,
     ):
         devices = list(devices if devices is not None else jax.devices())
         self.tp_shards = int(tp_shards)
@@ -105,7 +106,7 @@ class GSPMDEngine(WindowedEngine):
         self._shard = NamedSharding(self.mesh, P(WORKER_AXIS))
         self._finish_init(
             loss, worker_optimizer, metrics, compute_dtype,
-            sync_model_state, commit_schedule,
+            sync_model_state, commit_schedule, remat,
         )
 
     # ------------------------------------------------------------- shardings
