@@ -16,7 +16,16 @@ __version__ = "0.1.0"
 
 from distkeras_tpu import frame, utils
 from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
-from distkeras_tpu.frame import DataFrame, Row, from_numpy, from_pandas, from_rows, from_spark, read_csv
+from distkeras_tpu.frame import (
+    DataFrame,
+    Row,
+    from_numpy,
+    from_pandas,
+    from_rows,
+    from_spark,
+    read_csv,
+    to_spark,
+)
 from distkeras_tpu.predictors import ModelPredictor
 from distkeras_tpu.trainers import (
     ADAG,
@@ -46,6 +55,7 @@ __all__ = [
     "from_numpy",
     "from_pandas",
     "from_spark",
+    "to_spark",
     "from_rows",
     "read_csv",
     "Trainer",

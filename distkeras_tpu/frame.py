@@ -24,7 +24,7 @@ import numpy as np
 
 __all__ = [
     "DataFrame", "Row", "from_rows", "from_numpy", "from_pandas",
-    "from_spark", "read_csv",
+    "from_spark", "to_spark", "read_csv",
 ]
 
 
@@ -301,6 +301,43 @@ def from_spark(sdf, columns: Sequence[str] | None = None) -> DataFrame:
     except Exception:
         num_partitions = 1
     return DataFrame({c: _as_column(v) for c, v in data.items()}, num_partitions)
+
+
+def to_spark(df: DataFrame, spark, columns: Sequence[str] | None = None):
+    """Write the columnar frame back out as a **pyspark** DataFrame — the
+    egress half of the Spark boundary (``from_spark`` is the ingress half).
+
+    The reference's whole flow lived inside Spark DataFrames, so a pipeline
+    could end with ``predictor.predict(df)`` feeding downstream Spark ML
+    (SURVEY.md §2 Predictors row); migrating users close the loop with
+    ``dk.to_spark(frame, spark)`` after training/inference here.
+
+    Vector-valued columns (multi-dim or object rows — features, predictions)
+    become per-row Python float lists, which Spark infers as ``array<double>``;
+    scalar columns pass through.  Hands ``spark.createDataFrame`` a pandas
+    frame when pandas imports (Arrow fast path, mirroring ``from_spark``'s
+    ``toPandas`` preference), else a list of plain dict rows.
+
+    pyspark itself is NOT a dependency: this function only calls
+    ``createDataFrame`` on the session object it's handed.
+    """
+    names = list(columns) if columns is not None else df.columns
+
+    def pyify(name):
+        col = df.column(name)
+        if col.dtype == object or col.ndim > 1:
+            return [np.asarray(v).ravel().astype(float).tolist() for v in col]
+        return col.tolist()
+
+    data = {name: pyify(name) for name in names}
+    try:
+        import pandas as pd
+    except ImportError:
+        pd = None
+    if pd is not None:
+        return spark.createDataFrame(pd.DataFrame(data))
+    rows = [{name: data[name][i] for name in names} for i in range(len(df))]
+    return spark.createDataFrame(rows)
 
 
 def read_csv(path: str, header: bool = True, num_partitions: int = 1) -> DataFrame:

@@ -98,6 +98,7 @@ class WindowedEngine:
         mesh=None,
         seq_shards: int = 1,
         remat: bool = False,
+        unroll=1,
     ):
         self.adapter = adapter
         self.rule = rule
@@ -127,12 +128,12 @@ class WindowedEngine:
         self._shard = worker_sharding(self.mesh)
         self._finish_init(
             loss, worker_optimizer, metrics, compute_dtype,
-            sync_model_state, commit_schedule, remat,
+            sync_model_state, commit_schedule, remat, unroll,
         )
 
     def _finish_init(
         self, loss, worker_optimizer, metrics, compute_dtype,
-        sync_model_state, commit_schedule, remat=False,
+        sync_model_state, commit_schedule, remat=False, unroll=1,
     ):
         """Mesh-independent setup shared with subclasses (GSPMDEngine):
         optimizer/loss/metric resolution and commit-schedule validation.
@@ -145,6 +146,12 @@ class WindowedEngine:
         # trades FLOPs for activation memory — the HBM lever for deep models
         # (ResNet-scale+) whose per-window activations outgrow the chip.
         self.remat = bool(remat)
+        # Unroll factor for the per-step scans (int, or True = full unroll).
+        # On TPU a small unroll lets XLA pipeline across steps; on the CPU
+        # test mesh full unroll avoids XLA:CPU's pathological compile times
+        # for conv bodies inside while-loops (measured: a 4-step scanned
+        # CIFARCNN step compiles ~75s as a loop, ~5s fully unrolled).
+        self.unroll = unroll
         self.sync_model_state = sync_model_state
         # Per-worker commit periods (staleness simulation).  None => uniform
         # synchronous windows, one collective per window.
@@ -282,7 +289,8 @@ class WindowedEngine:
         def per_worker_window(center_params, center_rule, local, wdata):
             local_params, opt_state, model_state, rule_local, rng = local
             (local_params, opt_state, model_state, rng), (losses, mets) = lax.scan(
-                self._local_step, (local_params, opt_state, model_state, rng), wdata
+                self._local_step, (local_params, opt_state, model_state, rng),
+                wdata, unroll=self.unroll,
             )
             if do_commit:
                 ctx = self._make_ctx(True, float(window))
@@ -325,8 +333,11 @@ class WindowedEngine:
                 center_rule = jax.tree.map(lambda x: x[0], centers_r)
                 return (center_params, center_rule, local), (loss, mets)
 
+            # full unroll propagates to the window loop too (unroll=True is
+            # the XLA:CPU compile-time escape hatch; ints stay step-only)
             (center_params, center_rule, local), (losses, mets) = lax.scan(
-                window_body, (center_params, center_rule, local), (xs, ys)
+                window_body, (center_params, center_rule, local), (xs, ys),
+                unroll=self.unroll is True,
             )
             # losses: [n_windows, v]; mets: [n_windows, v, M].  Single
             # end-of-epoch reduction over virtual workers + mesh devices.
@@ -419,7 +430,7 @@ class WindowedEngine:
             since0 = jnp.zeros((schedule.shape[0],), jnp.int32)
             (center_params, center_rule, local, _), losses = lax.scan(
                 step_body, (center_params, center_rule, local, since0),
-                (jnp.arange(n_steps), (xs, ys)),
+                (jnp.arange(n_steps), (xs, ys)), unroll=self.unroll,
             )
             # losses: [n_steps, v] — one end-of-epoch reduction (see the
             # windowed epoch fn for why this is not done per step).

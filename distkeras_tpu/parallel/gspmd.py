@@ -75,6 +75,7 @@ class GSPMDEngine(WindowedEngine):
         commit_schedule: Optional[np.ndarray] = None,
         devices: Optional[Sequence] = None,
         remat: bool = False,
+        unroll=1,
     ):
         devices = list(devices if devices is not None else jax.devices())
         self.tp_shards = int(tp_shards)
@@ -106,7 +107,7 @@ class GSPMDEngine(WindowedEngine):
         self._shard = NamedSharding(self.mesh, P(WORKER_AXIS))
         self._finish_init(
             loss, worker_optimizer, metrics, compute_dtype,
-            sync_model_state, commit_schedule, remat,
+            sync_model_state, commit_schedule, remat, unroll,
         )
 
     # ------------------------------------------------------------- shardings
@@ -207,10 +208,11 @@ class GSPMDEngine(WindowedEngine):
                 local = self._constrain_worker(local)
                 return (center_params, center_rule, local), (loss, mets)
 
+            # see the shard_map engine: unroll=True propagates to this loop
             (center_params, center_rule, local), (losses, mets) = lax.scan(
                 window_body,
                 (state.center_params, state.center_rule, local),
-                (xs, ys),
+                (xs, ys), unroll=self.unroll is True,
             )
             local_params, opt_state, model_state, rule_local, rng = local
             # losses/mets carry a [n_windows, num_workers] leading block; the
@@ -267,7 +269,7 @@ class GSPMDEngine(WindowedEngine):
             (center_params, center_rule, local, _), losses = lax.scan(
                 step_body,
                 (state.center_params, state.center_rule, local, since0),
-                (jnp.arange(n_steps), (xs, ys)),
+                (jnp.arange(n_steps), (xs, ys)), unroll=self.unroll,
             )
             local_params, opt_state, model_state, rule_local, rng = local
             new_state = TrainState(

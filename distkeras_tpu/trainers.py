@@ -77,6 +77,8 @@ class Trainer:
         tp_shards: int = 1,
         tensorboard_dir: Optional[str] = None,
         streaming: bool = False,
+        remat: bool = False,
+        unroll=1,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -105,6 +107,15 @@ class Trainer:
         # double-buffered iterator instead of materialising whole epochs
         # (identical trajectory; for datasets approaching HBM size).
         self.streaming = bool(streaming)
+        # Rematerialise forward activations on the backward pass
+        # (jax.checkpoint in both engines): trades FLOPs for HBM — the lever
+        # for deep models (ResNet-scale+) whose per-window activations
+        # outgrow the chip.  Gradients are mathematically identical; see
+        # tests/test_fixes_r3.py (trajectory-equality on ResNet20).
+        self.remat = bool(remat)
+        # Per-step scan unroll factor (int, or True = full unroll) — see
+        # WindowedEngine._finish_init.  Math is unroll-invariant.
+        self.unroll = unroll
         # sequence parallelism (ring attention) shards: >1 requires a
         # seq-axis-aware model (models/transformer.py)
         self.seq_shards = int(seq_shards)
@@ -137,7 +148,15 @@ class Trainer:
 
     # -- internals ----------------------------------------------------------
     def _load_columns(self, dataframe: DataFrame):
-        feats = dataframe.matrix(self.features_col, dtype=np.float32)
+        # Integer token features (TextCNN) must stay integral; every other
+        # feature column materialises as one float32 matrix.  Dtype is
+        # decided from the raw column BEFORE materialising, so the full
+        # dataset is copied exactly once per call.
+        f_raw = dataframe.column(self.features_col)
+        if f_raw.dtype != object and np.issubdtype(f_raw.dtype, np.integer):
+            feats = f_raw.astype(np.int32)
+        else:
+            feats = dataframe.matrix(self.features_col, dtype=np.float32)
         labels_raw = dataframe.column(self.label_col)
         if labels_raw.dtype == object:
             labels = dataframe.matrix(self.label_col, dtype=np.float32)
@@ -145,10 +164,6 @@ class Trainer:
             labels = labels_raw.astype(np.int32)
         else:
             labels = labels_raw.astype(np.float32)
-        # Integer token features (TextCNN) must stay integral.
-        f0 = dataframe.column(self.features_col)
-        if f0.dtype != object and np.issubdtype(f0.dtype, np.integer):
-            feats = f0.astype(np.int32)
         return feats, labels
 
     def _fit(
@@ -181,6 +196,8 @@ class Trainer:
                 metrics=self.metrics,
                 compute_dtype=self.compute_dtype,
                 commit_schedule=commit_schedule,
+                remat=self.remat,
+                unroll=self.unroll,
             )
         else:
             engine = WindowedEngine(
@@ -193,6 +210,8 @@ class Trainer:
                 compute_dtype=self.compute_dtype,
                 commit_schedule=commit_schedule,
                 seq_shards=self.seq_shards,
+                remat=self.remat,
+                unroll=self.unroll,
             )
         window = rule.communication_window if rule.communication_window > 0 else None
         rng = np.random.default_rng(self.seed)
@@ -423,12 +442,14 @@ class DistributedTrainer(Trainer):
         tp_shards: int = 1,
         tensorboard_dir: Optional[str] = None,
         streaming: bool = False,
+        remat: bool = False,
+        unroll=1,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
             checkpoint_dir, checkpoint_every, resume, profile_dir, seq_shards,
-            tp_shards, tensorboard_dir, streaming,
+            tp_shards, tensorboard_dir, streaming, remat, unroll,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port
@@ -569,7 +590,12 @@ class EAMSGD(AsynchronousDistributedTrainer):
 
     def __init__(self, *args, communication_window: int = 32, rho: float = 5.0,
                  learning_rate: float = 0.1, momentum: float = 0.9, **kwargs):
-        kwargs.setdefault("worker_optimizer", None)
+        # Default worker_optimizer to None (=> Nesterov momentum SGD via
+        # _effective_worker_optimizer) ONLY when the caller didn't pass one —
+        # positionally (reference style: EAMSGD(model, loss, "sgd")) or by
+        # keyword.  args[2] is worker_optimizer in the Trainer signature.
+        if len(args) < 3 and "worker_optimizer" not in kwargs:
+            kwargs["worker_optimizer"] = None
         super().__init__(*args, **kwargs)
         self.communication_window = communication_window
         self.rho = rho

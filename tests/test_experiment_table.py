@@ -17,12 +17,17 @@ from experiments import run_experiments
 # (measured on the 8-CPU mesh, see README.md)
 FLOORS = {
     "SingleTrainer": 0.92,
-    "DOWNPOUR": 0.84,
+    "DOWNPOUR": 0.90,
     "AEASGD": 0.92,
     "EAMSGD": 0.92,
-    "ADAG": 0.90,
-    "DynSGD": 0.84,
+    "ADAG": 0.91,
+    "DynSGD": 0.90,
 }
+
+# No async trainer may trail SingleTrainer by more than this at 8 workers
+# (VERDICT r2 item 4; measured worst gap is 1.6 points — DOWNPOUR/DynSGD at
+# worker-scaled LR).  Slack over the measured gap absorbs backend drift.
+MAX_GAP_TO_SINGLE = 0.025
 
 
 @pytest.mark.slow
@@ -40,3 +45,10 @@ def test_every_trainer_meets_accuracy_floor():
     assert not failures, f"trainers under their accuracy floor on {dataset}: {failures}"
     for name, (acc, seconds) in results.items():
         assert seconds > 0.0, name
+    single = results["SingleTrainer"][0]
+    gaps = {
+        name: round(single - acc, 4)
+        for name, (acc, _t) in results.items()
+        if name != "SingleTrainer" and single - acc > MAX_GAP_TO_SINGLE
+    }
+    assert not gaps, f"async trainers >:{MAX_GAP_TO_SINGLE} under SingleTrainer: {gaps}"

@@ -1,0 +1,202 @@
+"""Round-3 fix regressions (VERDICT r2 weak items 5, 7, 8): the ``remat``
+kwarg is public and trajectory-preserving, EAMSGD accepts reference-style
+positional arguments, and ``_load_columns`` materialises the dataset once."""
+
+import jax
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.algorithms import Adag
+from distkeras_tpu.frame import DataFrame, from_numpy
+from distkeras_tpu.models import MLP, FlaxModel, ResNet20
+from distkeras_tpu.parallel.engine import WindowedEngine
+
+
+def _mlp():
+    return FlaxModel(MLP(features=(16,), num_classes=2))
+
+
+# ---------------------------------------------------------------- remat
+
+
+def _tiny_images(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    return x, y
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_engine_accepts_remat(remat):
+    engine = WindowedEngine(
+        FlaxModel(ResNet20()), "categorical_crossentropy",
+        ("sgd", {"learning_rate": 0.1}), Adag(2),
+        num_workers=2, metrics=(), remat=remat,
+    )
+    assert engine.remat is remat
+
+
+def test_remat_trajectory_identical_on_resnet20():
+    """jax.checkpoint recomputes activations but must not change the math:
+    the ADAG/ResNet20 config (the model remat exists for) trains to
+    bit-identical center params with and without it."""
+    x, y = _tiny_images()
+
+    def run(remat):
+        engine = WindowedEngine(
+            FlaxModel(ResNet20()), "categorical_crossentropy",
+            ("sgd", {"learning_rate": 0.1, "momentum": 0.9}), Adag(2),
+            num_workers=2, metrics=(), remat=remat,
+        )
+        xs = x.reshape(2, 2, 2, 8, 8, 8, 3)  # [workers, windows, window, batch, ...]
+        ys = y.reshape(2, 2, 2, 8)
+        state = engine.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
+        xs, ys = engine.shard_batches(xs, ys)
+        state, _ = engine.run_epoch(state, xs, ys)
+        return jax.tree.map(np.asarray, state.center_params)
+
+    base, rematted = run(False), run(True)
+    flat_a, flat_b = jax.tree.leaves(base), jax.tree.leaves(rematted)
+    assert all(np.array_equal(a, b) for a, b in zip(flat_a, flat_b))
+
+
+def test_trainer_remat_kwarg_reaches_engine(toy_classification, monkeypatch):
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    seen = {}
+    orig_init = WindowedEngine.__init__
+
+    def spy(self, *args, **kwargs):
+        seen["remat"] = kwargs.get("remat")
+        return orig_init(self, *args, **kwargs)
+
+    monkeypatch.setattr(WindowedEngine, "__init__", spy)
+    t = dk.DOWNPOUR(_mlp(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                    num_workers=2, batch_size=16, num_epoch=1,
+                    communication_window=4, remat=True)
+    t.train(df)
+    assert seen["remat"] is True
+
+
+# ---------------------------------------------------------------- unroll
+
+
+@pytest.mark.parametrize("unroll", [2, True])
+def test_unroll_trajectory_identical(toy_classification, unroll):
+    """lax.scan unroll is codegen, not math: center params after an epoch are
+    bit-identical for unroll=1 (default), partial, and full unroll."""
+    x, y, onehot = toy_classification
+
+    def run(unroll):
+        from distkeras_tpu.algorithms import Downpour
+
+        engine = WindowedEngine(
+            _mlp(), "categorical_crossentropy",
+            ("sgd", {"learning_rate": 0.05}), Downpour(4),
+            num_workers=2, metrics=(), unroll=unroll,
+        )
+        xs = x[:256].reshape(2, 2, 4, 16, 8)
+        ys = onehot[:256].reshape(2, 2, 4, 16, 2)
+        state = engine.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
+        xs, ys = engine.shard_batches(xs, ys)
+        state, _ = engine.run_epoch(state, xs, ys)
+        return jax.tree.map(np.asarray, state.center_params)
+
+    base, unrolled = run(1), run(unroll)
+    assert all(
+        np.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(unrolled))
+    )
+
+
+def test_trainer_unroll_kwarg_reaches_engine(toy_classification, monkeypatch):
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    seen = {}
+    orig_init = WindowedEngine.__init__
+
+    def spy(self, *args, **kwargs):
+        seen["unroll"] = kwargs.get("unroll")
+        return orig_init(self, *args, **kwargs)
+
+    monkeypatch.setattr(WindowedEngine, "__init__", spy)
+    t = dk.DOWNPOUR(_mlp(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                    num_workers=2, batch_size=16, num_epoch=1,
+                    communication_window=4, unroll=True)
+    t.train(df)
+    assert seen["unroll"] is True
+
+
+# ---------------------------------------------------------------- EAMSGD args
+
+
+def test_eamsgd_positional_worker_optimizer(toy_classification):
+    """Reference call style: EAMSGD(model, loss, worker_optimizer, ...).
+    Round 2's kwargs.setdefault passed worker_optimizer twice -> TypeError."""
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    t = dk.EAMSGD(_mlp(), "categorical_crossentropy", "sgd",
+                  num_workers=2, batch_size=16, num_epoch=1,
+                  communication_window=4)
+    assert t.worker_optimizer == "sgd"
+    assert t._effective_worker_optimizer() == "sgd"
+    t.train(df)  # end to end with the positional optimizer
+
+
+def test_eamsgd_default_still_nesterov(toy_classification):
+    t = dk.EAMSGD(_mlp(), "categorical_crossentropy", num_workers=2,
+                  learning_rate=0.05, momentum=0.8)
+    assert t.worker_optimizer is None
+    name, kwargs = t._effective_worker_optimizer()
+    assert name == "sgd" and kwargs["nesterov"] and kwargs["momentum"] == 0.8
+
+
+# ---------------------------------------------------------------- _load_columns
+
+
+def test_load_columns_materialises_once(toy_classification):
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    calls = []
+    orig = DataFrame.matrix
+
+    def counting_matrix(self, name, dtype=np.float32):
+        calls.append(name)
+        return orig(self, name, dtype)
+
+    t = dk.SingleTrainer(_mlp(), batch_size=16)
+    try:
+        DataFrame.matrix = counting_matrix
+        feats, labels = t._load_columns(df)
+    finally:
+        DataFrame.matrix = orig
+    # float features: exactly one matrix() materialisation; labels came from
+    # the already-dense onehot column (one more) — never two for features.
+    assert calls.count("features") == 1
+    assert feats.dtype == np.float32 and labels.dtype == np.float32
+
+
+def test_load_columns_integer_tokens_no_float_copy():
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 100, size=(32, 16)).astype(np.int64)
+    labels = rng.integers(0, 2, size=32).astype(np.int64)
+    df = from_numpy(tokens, labels)
+    calls = []
+    orig = DataFrame.matrix
+
+    def counting_matrix(self, name, dtype=np.float32):
+        calls.append(name)
+        return orig(self, name, dtype)
+
+    t = dk.SingleTrainer(_mlp(), batch_size=16)
+    try:
+        DataFrame.matrix = counting_matrix
+        feats, lab = t._load_columns(df)
+    finally:
+        DataFrame.matrix = orig
+    assert feats.dtype == np.int32  # token ids stay integral
+    assert lab.dtype == np.int32
+    assert "features" not in calls  # no wasted float materialisation at all
