@@ -175,7 +175,9 @@ class GSPMDEngine(WindowedEngine):
             return jax.jit(_build)(params, model_state)
 
     # ------------------------------------------------------------------ epoch
-    def _make_epoch_fn(self, n_windows: int, window: int, do_commit: bool, xs_ndim: int = 5):
+    def _build_epoch_core(self, n_windows: int, window: int, do_commit: bool, xs_ndim: int = 5):
+        """Un-jitted one-epoch function; the base class jits it directly
+        (``_make_epoch_fn``) or scans it (``run_epochs``)."""
         vmapped = jax.vmap(
             self._window_fn(do_commit, window),
             in_axes=(None, None, 0, 0),
@@ -233,7 +235,7 @@ class GSPMDEngine(WindowedEngine):
             )
             return new_state, stats
 
-        return jax.jit(epoch_fn, donate_argnums=(0,))
+        return epoch_fn
 
     def _make_stepwise_epoch_fn(self, n_steps: int, xs_ndim: int = 4):
         """Staleness simulation under TP: the same per-step masked-commit body
