@@ -79,6 +79,7 @@ class Trainer:
         streaming: bool = False,
         remat: bool = False,
         unroll=1,
+        dispatch_epochs: int = 1,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -116,6 +117,20 @@ class Trainer:
         # Per-step scan unroll factor (int, or True = full unroll) — see
         # WindowedEngine._finish_init.  Math is unroll-invariant.
         self.unroll = unroll
+        # >1: run up to this many epochs per device dispatch
+        # (engine.run_epochs) with ON-DEVICE inter-epoch reshuffling,
+        # amortising the fixed per-epoch host round-trip (measurement:
+        # WindowedEngine._make_multi_epoch_fn).  The reshuffle draws from the
+        # device RNG stream, not the host rng, so trajectories legitimately
+        # differ from dispatch_epochs=1 (both are uniform permutations).
+        # Checkpoint cadence is preserved: chunks never straddle a
+        # checkpoint_every boundary.  Incompatible with streaming=True and
+        # with staleness schedules (both need per-epoch host involvement).
+        self.dispatch_epochs = int(dispatch_epochs)
+        if self.dispatch_epochs < 1:
+            raise ValueError(
+                f"dispatch_epochs must be >= 1, got {dispatch_epochs}"
+            )
         # sequence parallelism (ring attention) shards: >1 requires a
         # seq-axis-aware model (models/transformer.py)
         self.seq_shards = int(seq_shards)
@@ -228,8 +243,11 @@ class Trainer:
                 start_epoch = int(np.asarray(state.epoch))
 
         # keep the host RNG stream aligned with the epoch counter on resume
-        for _ in range(start_epoch):
-            rng.permutation(len(feats))
+        # (chunked dispatch shuffles on device, keyed by state.epoch — its
+        # alignment is free and the host stream is never drawn from)
+        if self.dispatch_epochs == 1:
+            for _ in range(start_epoch):
+                rng.permutation(len(feats))
 
         scalar_log = None
         if self.tensorboard_dir:
@@ -257,6 +275,23 @@ class Trainer:
                 "streaming=True is incompatible with commit_schedule: the "
                 "staleness simulation scans the whole epoch in one program"
             )
+        if self.dispatch_epochs > 1:
+            if self.streaming:
+                raise ValueError(
+                    "dispatch_epochs>1 needs the whole epoch on device; "
+                    "streaming=True feeds it window by window"
+                )
+            if commit_schedule is not None:
+                raise ValueError(
+                    "dispatch_epochs>1 is incompatible with commit_schedule "
+                    "(the staleness simulation dispatches per epoch)"
+                )
+            state, epoch_stats = self._train_chunked(
+                engine, state, feats, labels, num_workers, window, shuffle,
+                ckpt, start_epoch, _materialise,
+            )
+            # all epochs consumed; the per-epoch loop below runs zero times
+            start_epoch = self.num_epoch
         stream_window = window
         if self.streaming and window is None:
             # No-commit trainers (SingleTrainer/Ensemble) have no natural
@@ -336,6 +371,84 @@ class Trainer:
                 key = name if isinstance(name, str) else getattr(name, "__name__", f"metric_{i}")
                 self.history[key] = [float(m[i]) for m in metrics_per_epoch]
         return engine, state, adapter
+
+    def _train_chunked(
+        self, engine, state, feats, labels, num_workers, window,
+        shuffle, ckpt, start_epoch, _materialise,
+    ):
+        """The ``dispatch_epochs>1`` epoch loop: up to ``dispatch_epochs``
+        epochs per device dispatch via :meth:`WindowedEngine.run_epochs`,
+        reshuffling ON DEVICE between epochs when ``shuffle`` is set.
+
+        Chunks never straddle a ``checkpoint_every`` boundary, so the set of
+        checkpointed epochs is identical to the per-epoch loop's.  Returns
+        ``(state, epoch_stats)`` with every epoch's stats but the last
+        already materialised — the caller's trailing ``_materialise`` call
+        finishes the last one, same invariant as the per-epoch loop.
+        """
+        from distkeras_tpu.data import plan_epoch
+
+        if window is None:
+            steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
+            xs, ys = epoch_arrays(feats, labels, num_workers, self.batch_size, steps)
+        else:
+            xs, ys = epoch_arrays(feats, labels, num_workers, self.batch_size, window)
+        xs, ys = engine.shard_batches(xs, ys)
+        shuffle_seed = self.seed if shuffle else None
+
+        def split(stats, chunk):
+            """Chunk stats -> per-epoch dicts (leaves keep [n_windows, ...])."""
+            out = []
+            for e in range(chunk):
+                out.append(jax.tree.map(
+                    lambda a: a.reshape((chunk, a.shape[0] // chunk) + a.shape[1:])[e],
+                    stats,
+                ))
+            return out
+
+        epoch_stats: List[dict] = []
+        epoch = start_epoch
+        chunk_idx = 0
+        first_chunk_size = None
+        while epoch < self.num_epoch:
+            chunk = min(self.dispatch_epochs, self.num_epoch - epoch)
+            if ckpt is not None:
+                chunk = min(chunk, self.checkpoint_every - epoch % self.checkpoint_every)
+            if first_chunk_size is None:
+                first_chunk_size = chunk
+            # Trace the second chunk — but only if it reuses the first
+            # chunk's compiled program (same chunk size); a differently-sized
+            # tail chunk would trace a fresh XLA compile, not steady state.
+            # With a single chunk, trace it (compile included — better than
+            # nothing, and the per-epoch loop has the same property at
+            # num_epoch == 1).
+            last_chunk = epoch + chunk >= self.num_epoch
+            if self.profile_dir and (
+                (chunk_idx == 1 and chunk == first_chunk_size)
+                or (chunk_idx == 0 and last_chunk)
+            ):
+                with jax.profiler.trace(self.profile_dir):
+                    state, stats = engine.run_epochs(
+                        state, xs, ys, chunk, shuffle_seed=shuffle_seed)
+                    jax.block_until_ready(state.center_params)
+            else:
+                state, stats = engine.run_epochs(
+                    state, xs, ys, chunk, shuffle_seed=shuffle_seed)
+            # Same O(1)-retention scheme as the per-epoch loop: materialise
+            # the previous chunk's stats (long computed) while this chunk's
+            # stay device-resident.
+            for i, s in enumerate(epoch_stats):
+                if not isinstance(jax.tree.leaves(s)[0], np.ndarray):
+                    epoch_stats[i] = _materialise(s, i + start_epoch)
+            epoch_stats.extend(split(stats, chunk))
+            epoch += chunk
+            chunk_idx += 1
+            if ckpt is not None:
+                ckpt.maybe_save(state, epoch - 1)
+        for i, s in enumerate(epoch_stats[:-1]):
+            if not isinstance(jax.tree.leaves(s)[0], np.ndarray):
+                epoch_stats[i] = _materialise(s, i + start_epoch)
+        return state, epoch_stats
 
     def _finalize(self, engine: WindowedEngine, state, adapter: ModelAdapter, use_center: bool = True):
         """Materialise the trained model in the same type the user passed in."""
@@ -444,12 +557,14 @@ class DistributedTrainer(Trainer):
         streaming: bool = False,
         remat: bool = False,
         unroll=1,
+        dispatch_epochs: int = 1,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
             checkpoint_dir, checkpoint_every, resume, profile_dir, seq_shards,
             tp_shards, tensorboard_dir, streaming, remat, unroll,
+            dispatch_epochs,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port
