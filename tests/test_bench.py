@@ -57,6 +57,25 @@ def test_baseline_file_pins_every_config():
     assert not missing, f"every config must carry a real-TPU pin: {missing}"
 
 
+def test_calibration_path_runs_and_clears_programs(monkeypatch):
+    # reps=None exercises the two-point calibration: it must produce a
+    # sane rep count and leave ONLY the final timed program alive (a live
+    # extra executable degrades steady-state TPU throughput — see
+    # WindowedEngine.clear_program_cache).
+    engine, _, window, shape, int_data, classes = bench._engine_for("mnist_mlp_single")
+    monkeypatch.setattr(
+        bench, "_engine_for",
+        lambda config, num_workers=None: (engine, 8, window, shape, int_data, classes),
+    )
+    out = bench.run_config("mnist_mlp_single", n_windows=1, reps=None, k=1,
+                           min_set_seconds=0.01)
+    assert out["value"] > 0
+    # the calibration programs (reps=1, reps=4) were evicted; only the final
+    # multi-epoch program remains cached
+    keys = list(engine._epoch_fns)
+    assert len(keys) == 1 and keys[0][0] == "multi"
+
+
 def test_analytic_flops_closed_form():
     # Hand-recomputed layer sums (see _FWD_FLOPS helpers): any drift between
     # the model zoo and these formulas must be deliberate.
