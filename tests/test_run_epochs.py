@@ -80,11 +80,15 @@ def test_run_epochs_bit_identical_to_sequential(make_engine):
     np.testing.assert_array_equal(np.asarray(multi_stats["loss"]), seq_losses)
 
 
-def test_run_epochs_on_device_shuffle_deterministic_and_effective():
+@pytest.mark.parametrize("make_engine", [_windowed, _gspmd], ids=["shard_map", "gspmd"])
+def test_run_epochs_on_device_shuffle_deterministic_and_effective(make_engine):
+    # under GSPMD the permutation gather crosses worker shards on the 2-D
+    # (workers, model) mesh — the partitioner must insert the implied
+    # collectives AND preserve the exact permutation semantics
     xs_np, ys_np = _data()
 
     def run(shuffle_seed):
-        eng = _windowed()
+        eng = make_engine()
         state = eng.init_state(jax.random.PRNGKey(0), xs_np[0, 0, 0])
         xs, ys = eng.shard_batches(xs_np, ys_np)
         state, stats = eng.run_epochs(state, xs, ys, 3, shuffle_seed=shuffle_seed)
