@@ -194,7 +194,7 @@ def _probe_subprocess(timeout: float):
     return False, tail[-1] if tail else f"probe exited rc={proc.returncode}"
 
 
-def preflight(max_tries: int = 3, init_timeout: float = 120.0, retry_sleep: float = 15.0):
+def preflight(max_tries: int = 6, init_timeout: float = 120.0, retry_sleep: float = 45.0):
     """Establish a live JAX backend before any measurement.
 
     Availability is probed in child processes (bounded, genuinely retryable

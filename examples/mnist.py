@@ -47,6 +47,9 @@ def main():
     parser.add_argument("--workers", type=int, default=None)
     parser.add_argument("--epochs", type=int, default=5)
     parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--dispatch-epochs", type=int, default=1,
+                        help="epochs per device dispatch (>1: one jitted "
+                             "multi-epoch program with on-device reshuffle)")
     args = parser.parse_args()
 
     import jax
@@ -88,7 +91,8 @@ def main():
     trainer = dk.SingleTrainer(fresh_model(), loss="categorical_crossentropy",
                                worker_optimizer=("sgd", {"learning_rate": 0.1}),
                                features_col="features", label_col="label_encoded",
-                               batch_size=args.batch_size, num_epoch=args.epochs)
+                               batch_size=args.batch_size, num_epoch=args.epochs,
+                               dispatch_epochs=args.dispatch_epochs)
     results["SingleTrainer"] = (evaluate(trainer.train(train_df)),
                                 trainer.get_training_time())
 
@@ -103,7 +107,8 @@ def main():
                       worker_optimizer=("sgd", {"learning_rate": 0.1}),
                       features_col="features", label_col="label_encoded",
                       num_workers=num_workers, batch_size=args.batch_size,
-                      num_epoch=args.epochs, **kw)
+                      num_epoch=args.epochs,
+                      dispatch_epochs=args.dispatch_epochs, **kw)
         acc = evaluate(trainer.train(train_df))
         results[name] = (acc, trainer.get_training_time())
         print(f"  {name}: parameter-server updates = {trainer.num_updates}")
