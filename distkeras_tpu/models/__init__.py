@@ -7,6 +7,7 @@ from distkeras_tpu.models.adapter import (
     TrainedModel,
     as_adapter,
 )
+from distkeras_tpu.models.staged import StagedTransformer
 from distkeras_tpu.models.transformer import TransformerClassifier, TransformerEncoderBlock
 from distkeras_tpu.models.zoo import CIFARCNN, MLP, MNISTCNN, ResNet20, TextCNN
 
@@ -23,4 +24,5 @@ __all__ = [
     "TextCNN",
     "TransformerClassifier",
     "TransformerEncoderBlock",
+    "StagedTransformer",
 ]

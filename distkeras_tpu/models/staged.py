@@ -1,0 +1,136 @@
+"""Pipeline-staged transformer: the model half of pipeline parallelism.
+
+The reference has no pipeline parallelism (its only strategy is socket
+parameter-server data parallelism — SURVEY.md §2 parallelism census); this is
+a beyond-reference strategy in the same spirit as the GSPMD tensor-parallel
+engine.  The TPU-idiomatic formulation (scaling-book pipelining chapter): a
+stack of **homogeneous** transformer blocks is split into ``num_stages``
+stages of ``blocks_per_stage`` blocks each, block parameters are *stacked*
+along a leading ``[num_stages]`` axis so they shard cleanly over a ``stages``
+mesh axis, and microbatches stream through the stages via ``ppermute``
+neighbour exchanges (see :mod:`distkeras_tpu.parallel.pipeline`).
+
+The embedding and the classifier head are deliberately *not* staged: they are
+small next to the block stack, stay replicated, and are computed by every
+stage device (masked into the pipeline on stage 0 / the last stage).
+
+``StagedTransformer`` is a plain :class:`ModelAdapter` whose ``apply`` runs
+the stages **sequentially** — the single-device reference semantics used for
+initialisation, prediction, and the equivalence tests.  The pipelined
+schedule is a different *executor* of the same parameters, not a different
+model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.models.adapter import ModelAdapter
+from distkeras_tpu.models.transformer import TransformerEncoderBlock
+
+__all__ = ["StagedTransformer"]
+
+
+class _Embed(nn.Module):
+    vocab_size: int
+    dim: int
+    max_len: int
+
+    @nn.compact
+    def __call__(self, tokens):
+        tokens = tokens.astype(jnp.int32)
+        positions = jnp.arange(tokens.shape[1])
+        x = nn.Embed(self.vocab_size, self.dim, name="tok_embed")(tokens)
+        return x + nn.Embed(self.max_len, self.dim, name="pos_embed")(positions)[None]
+
+
+class _Head(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm()(x)
+        token_logits = nn.Dense(self.num_classes, name="out")(x)
+        return token_logits.sum(axis=1) / x.shape[1]
+
+
+@dataclasses.dataclass
+class StagedTransformer(ModelAdapter):
+    """Token classifier over ``[batch, seq]`` int32 inputs with its encoder
+    blocks stacked ``[num_stages, blocks_per_stage, ...]`` for pipelining.
+
+    Parameter layout (the contract :class:`~distkeras_tpu.parallel.pipeline.
+    PipelineEngine` relies on)::
+
+        {"embed": <replicated>, "blocks": <leaves [S, per_stage, ...]>,
+         "head": <replicated>}
+    """
+
+    vocab_size: int
+    num_classes: int = 2
+    dim: int = 128
+    heads: int = 4
+    num_stages: int = 2
+    blocks_per_stage: int = 1
+    max_len: int = 2048
+    outputs_logits: bool = True
+
+    def __post_init__(self):
+        self._embed = _Embed(self.vocab_size, self.dim, self.max_len)
+        self._block = TransformerEncoderBlock(self.dim, self.heads)
+        self._head = _Head(self.num_classes)
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng: jax.Array, sample_input) -> Tuple[Any, Any]:
+        sample = jnp.asarray(sample_input)
+        r_embed, r_blocks, r_head = jax.random.split(rng, 3)
+        embed_p = self._embed.init(r_embed, sample)["params"]
+        h = self._embed.apply({"params": embed_p}, sample)
+        n_blocks = self.num_stages * self.blocks_per_stage
+        # homogeneous blocks: init each with its own key, stack the pytrees,
+        # then fold the flat [n_blocks] axis into [S, per_stage]
+        block_ps = [
+            self._block.init(jax.random.fold_in(r_blocks, i), h)["params"]
+            for i in range(n_blocks)
+        ]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *block_ps)
+        stacked = jax.tree.map(
+            lambda x: x.reshape((self.num_stages, self.blocks_per_stage) + x.shape[1:]),
+            stacked,
+        )
+        head_p = self._head.init(r_head, h)["params"]
+        return {"embed": embed_p, "blocks": stacked, "head": head_p}, {}
+
+    # ------------------------------------------------- stage pieces (public
+    # to the pipeline engine; all pure functions of explicit params)
+    def embed(self, embed_params, tokens):
+        return self._embed.apply({"params": embed_params}, tokens)
+
+    def stage(self, stage_params, h):
+        """Apply one stage: scan ``blocks_per_stage`` blocks whose param
+        leaves carry a leading ``[blocks_per_stage]`` axis."""
+
+        def body(x, p):
+            return self._block.apply({"params": p}, x), None
+
+        h, _ = lax.scan(body, h, stage_params)
+        return h
+
+    def head(self, head_params, h):
+        return self._head.apply({"params": head_params}, h)
+
+    # ----------------------------------------------------------- sequential
+    def apply(self, params, state, inputs, training=False, rng=None):
+        h = self.embed(params["embed"], inputs)
+
+        def body(x, p):
+            return self.stage(p, x), None
+
+        h, _ = lax.scan(body, h, params["blocks"])
+        return self.head(params["head"], h), state

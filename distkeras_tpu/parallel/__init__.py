@@ -3,6 +3,7 @@ attention (sequence parallelism)."""
 
 from distkeras_tpu.parallel.engine import TrainState, WindowedEngine, plan_workers
 from distkeras_tpu.parallel.gspmd import TP_AXIS, GSPMDEngine
+from distkeras_tpu.parallel.pipeline import PP_AXIS, PipelineEngine
 from distkeras_tpu.parallel.mesh import (
     SEQ_AXIS,
     WORKER_AXIS,
@@ -22,6 +23,8 @@ __all__ = [
     "WindowedEngine",
     "GSPMDEngine",
     "TP_AXIS",
+    "PipelineEngine",
+    "PP_AXIS",
     "TrainState",
     "plan_workers",
     "make_mesh",

@@ -179,29 +179,44 @@ class WindowedEngine:
             )(sample)
         else:
             params, model_state = self.adapter.init(rng, sample_input)
-        n = self.num_workers
 
         def _build(params, model_state):
-            center_rule = self.rule.init_center_state()
-            rule_local = self.rule.init_local_state(params)
-            tile = lambda t: jax.tree.map(
-                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
-            )
-            local_params = tile(params)
-            opt_state = jax.vmap(self.optimizer.init)(local_params)
-            rngs = jax.random.split(jax.random.fold_in(rng, 1), n)
-            return TrainState(
-                center_params=params,
-                center_rule=center_rule,
-                local_params=local_params,
-                opt_state=opt_state,
-                model_state=tile(model_state),
-                rule_local=tile(rule_local),
-                rng=rngs,
-                epoch=jnp.zeros((), jnp.int32),
-            )
+            return self._assemble_state(rng, params, model_state)
 
-        shardings = TrainState(
+        shardings = self._state_shardings(_build, params, model_state)
+        with self.mesh:
+            return jax.jit(_build, out_shardings=shardings)(params, model_state)
+
+    def _assemble_state(self, rng, params, model_state) -> TrainState:
+        """Pure state assembly (jittable): tile per-worker leaves, init the
+        optimizer and rule states."""
+        n = self.num_workers
+        center_rule = self.rule.init_center_state()
+        rule_local = self.rule.init_local_state(params)
+        tile = lambda t: jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
+        )
+        local_params = tile(params)
+        opt_state = jax.vmap(self.optimizer.init)(local_params)
+        rngs = jax.random.split(jax.random.fold_in(rng, 1), n)
+        return TrainState(
+            center_params=params,
+            center_rule=center_rule,
+            local_params=local_params,
+            opt_state=opt_state,
+            model_state=tile(model_state),
+            rule_local=tile(rule_local),
+            rng=rngs,
+            epoch=jnp.zeros((), jnp.int32),
+        )
+
+    def _state_shardings(self, build_fn, params, model_state):
+        """out_shardings for the initial state: center leaves replicated,
+        per-worker leaves split on the worker axis.  The pipeline engine
+        overrides this with per-leaf shardings (stage-stacked leaves shard
+        over the stages axis too)."""
+        del build_fn, params, model_state
+        return TrainState(
             center_params=self._rep,
             center_rule=self._rep,
             local_params=self._shard,
@@ -211,8 +226,6 @@ class WindowedEngine:
             rng=self._shard,
             epoch=self._rep,
         )
-        with self.mesh:
-            return jax.jit(_build, out_shardings=shardings)(params, model_state)
 
     # ------------------------------------------------------------- local step
     def _local_step(self, carry, batch):
@@ -241,18 +254,41 @@ class WindowedEngine:
         (loss, (model_state, mets)), grads = jax.value_and_grad(compute_loss, has_aux=True)(
             params, model_state
         )
-        if self.seq_axis is not None:
-            # Sequence-parallel gradient sync.  Each shard's backward pass
-            # yields seq_shards x (its partial gradient): the loss is computed
-            # replicated on every shard and psum's transpose inside shard_map
-            # is itself a psum, so every replica's cotangent lands on each
-            # shard.  pmean over the axis = psum(partials)/shards = the exact
-            # total gradient (verified against the unsharded model in
-            # tests/test_sequence_parallel.py).
-            grads = jax.tree.map(lambda g: lax.pmean(g, self.seq_axis), grads)
+        grads = self._sync_grads(grads)
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return (params, opt_state, model_state, rng), (loss, mets)
+
+    def _sync_grads(self, grads):
+        """Cross-model-axis gradient sync hook (worker-axis reduction is the
+        commit rules' job, not this one's).
+
+        Sequence parallelism: each shard's backward pass yields seq_shards x
+        (its partial gradient): the loss is computed replicated on every shard
+        and psum's transpose inside shard_map is itself a psum, so every
+        replica's cotangent lands on each shard.  pmean over the axis =
+        psum(partials)/shards = the exact total gradient (verified against
+        the unsharded model in tests/test_sequence_parallel.py).
+
+        The pipeline engine overrides this with its stage-axis sync
+        (:meth:`distkeras_tpu.parallel.pipeline.PipelineEngine._sync_grads`).
+        """
+        if self.seq_axis is not None:
+            grads = jax.tree.map(lambda g: lax.pmean(g, self.seq_axis), grads)
+        return grads
+
+    def _local_in_spec(self):
+        """shard_map spec (or per-leaf spec tree) for the per-worker ``local``
+        5-tuple.  A single ``P(workers)`` prefix here; the pipeline engine
+        returns full per-leaf trees (stage-stacked leaves shard over the
+        stages axis too)."""
+        return P(self.axis)
+
+    def _center_in_specs(self):
+        """shard_map specs (or per-leaf spec trees) for
+        ``(center_params, center_rule)`` — replicated here; the pipeline
+        engine shards stage-stacked center leaves over the stages axis."""
+        return P(), P()
 
     def _make_ctx(self, mask, steps_in_window) -> CommitCtx:
         """Commit context whose psum totals over BOTH the vmap (virtual
@@ -350,11 +386,13 @@ class WindowedEngine:
             return center_params, center_rule, local, losses, mets
 
         xs_spec, ys_spec = self._data_specs(xs_ndim)
+        center_spec, center_rule_spec = self._center_in_specs()
+        local_spec = self._local_in_spec()
         mapped = jax.shard_map(
             worker_fn,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(self.axis), xs_spec, ys_spec),
-            out_specs=(P(), P(), P(self.axis), P(), P()),
+            in_specs=(center_spec, center_rule_spec, local_spec, xs_spec, ys_spec),
+            out_specs=(center_spec, center_rule_spec, local_spec, P(), P()),
             check_vma=False,
         )
 
@@ -511,11 +549,14 @@ class WindowedEngine:
             return center_params, center_rule, local, losses
 
         xs_spec, ys_spec = self._data_specs(xs_ndim)
+        center_spec, center_rule_spec = self._center_in_specs()
+        local_spec = self._local_in_spec()
         mapped = jax.shard_map(
             worker_fn,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(self.axis), xs_spec, ys_spec, P(self.axis)),
-            out_specs=(P(), P(), P(self.axis), P()),
+            in_specs=(center_spec, center_rule_spec, local_spec, xs_spec, ys_spec,
+                      P(self.axis)),
+            out_specs=(center_spec, center_rule_spec, local_spec, P()),
             check_vma=False,
         )
 
