@@ -121,7 +121,8 @@ def try_real_imdb(seq_len=256, vocab=20000):
 
 
 def run_accuracy(num_workers=None, epochs=4, n_train=8192, n_test=2048,
-                 batch_size=64, include=("cifar", "imdb"), window=None):
+                 batch_size=64, include=("cifar", "imdb"), window=None,
+                 lr=1e-3):
     """Returns a list of result dicts (one per model)."""
     import jax
 
@@ -133,7 +134,7 @@ def run_accuracy(num_workers=None, epochs=4, n_train=8192, n_test=2048,
         # No larger than the per-worker steps in one epoch, so the wrap
         # padding to a window multiple doesn't multiply the work on small runs.
         steps_per_epoch = max(1, n_train // (num_workers * batch_size))
-        window = max(1, min(16, steps_per_epoch))
+        window = max(1, min(4, steps_per_epoch))
     results = []
 
     if "cifar" in include:
@@ -148,7 +149,11 @@ def run_accuracy(num_workers=None, epochs=4, n_train=8192, n_test=2048,
             dk.DOWNPOUR, FlaxModel(CIFARCNN()), train, test,
             num_workers=num_workers,
             trainer_kwargs={
-                "worker_optimizer": ("adam", {"learning_rate": 1e-3 / num_workers}),
+                # DOWNPOUR's commit adds the SUM of worker deltas to the
+                # center, so the worker lr divides by the worker count to keep
+                # the center step at ``lr`` (the mis-tuning VERDICT r2 item 4
+                # flagged on the digits table).
+                "worker_optimizer": ("adam", {"learning_rate": lr / num_workers}),
                 "communication_window": window,
                 # full unroll of the per-step scan: math-invariant, and on the
                 # CPU test mesh it sidesteps XLA:CPU's pathological compile
@@ -173,7 +178,10 @@ def run_accuracy(num_workers=None, epochs=4, n_train=8192, n_test=2048,
             dk.DynSGD, FlaxModel(TextCNN(vocab_size=20000, num_classes=2)),
             train, test, num_workers=num_workers,
             trainer_kwargs={
-                "worker_optimizer": ("adam", {"learning_rate": 1e-3 / num_workers}),
+                # DynSGD divides each delta by (staleness+1) itself, but with
+                # uniform windows every worker has staleness 0 — same sum-of-
+                # deltas scaling as DOWNPOUR, same lr correction.
+                "worker_optimizer": ("adam", {"learning_rate": lr / num_workers}),
                 "communication_window": window,
                 "unroll": True,
             },
@@ -192,6 +200,9 @@ def main():
     parser.add_argument("--test", type=int, default=2048)
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--workers", type=int, default=None)
+    parser.add_argument("--window", type=int, default=None)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--include", type=str, default="cifar,imdb")
     parser.add_argument("--cpu", type=int, default=0, metavar="N",
                         help="force an N-device CPU mesh (offline / no TPU)")
     args = parser.parse_args()
@@ -202,8 +213,14 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
 
+    include = tuple(s.strip() for s in args.include.split(",") if s.strip())
+    unknown = set(include) - {"cifar", "imdb"}
+    if not include or unknown:
+        parser.error(f"--include takes a comma list of cifar,imdb (got {args.include!r})")
     for result in run_accuracy(args.workers, args.epochs, args.train,
-                               args.test, args.batch_size):
+                               args.test, args.batch_size,
+                               include=include,
+                               window=args.window, lr=args.lr):
         result["backend"] = jax.default_backend()
         print(json.dumps(result))
 
