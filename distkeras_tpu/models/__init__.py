@@ -7,6 +7,12 @@ from distkeras_tpu.models.adapter import (
     TrainedModel,
     as_adapter,
 )
+from distkeras_tpu.models.moe import (
+    MoEEncoderBlock,
+    MoEFeedForward,
+    MoETransformerClassifier,
+    expert_partition,
+)
 from distkeras_tpu.models.staged import StagedTransformer
 from distkeras_tpu.models.transformer import TransformerClassifier, TransformerEncoderBlock
 from distkeras_tpu.models.zoo import CIFARCNN, MLP, MNISTCNN, ResNet20, TextCNN
@@ -25,4 +31,8 @@ __all__ = [
     "TransformerClassifier",
     "TransformerEncoderBlock",
     "StagedTransformer",
+    "MoEFeedForward",
+    "MoEEncoderBlock",
+    "MoETransformerClassifier",
+    "expert_partition",
 ]

@@ -48,6 +48,13 @@ class ModelAdapter:
     ) -> Tuple[jnp.ndarray, Any]:
         raise NotImplementedError
 
+    def aux_loss(self, state: Any):
+        """Auxiliary training loss carried in the post-``apply`` state (e.g. a
+        MoE router's load-balance term).  The engines add this to the
+        objective each step; 0 for models without one."""
+        del state
+        return 0.0
+
 
 @dataclasses.dataclass
 class FlaxModel(ModelAdapter):
@@ -72,6 +79,15 @@ class FlaxModel(ModelAdapter):
             return out, dict(updates)
         out = self.module.apply(variables, inputs, training=training, rngs=rngs)
         return out, state
+
+    def aux_loss(self, state):
+        """Sum of the mutable ``losses`` collection (MoE load balance etc.)."""
+        from collections.abc import Mapping
+
+        leaves = jax.tree.leaves(state.get("losses", {})) if isinstance(state, Mapping) else []
+        if not leaves:
+            return 0.0
+        return sum(jnp.sum(l) for l in leaves)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,161 @@
+"""Mixture-of-experts models + expert parallelism placement.
+
+Beyond the reference's model scale (its zoo tops out at ResNet-20 /
+TextCNN — SURVEY.md §2): a Switch-style sparse MoE transformer whose expert
+FFNs are *stacked* along a leading ``[num_experts]`` axis, formulated the
+GShard/Switch way — static-shape one-hot dispatch/combine einsums with a
+per-expert token capacity — so XLA can partition the expert axis over the
+device mesh (expert parallelism) with no data-dependent shapes.
+
+Expert parallelism rides the GSPMD engine: :func:`expert_partition` is a
+``spec_fn`` for :class:`~distkeras_tpu.parallel.gspmd.GSPMDEngine` that
+places the leading expert axis of every ``[num_experts, ...]`` leaf on the
+``model`` mesh axis; the XLA partitioner inserts the token-shuffling
+collectives the placement implies (the all-to-all of a hand-written MoE).
+
+Routing is top-1 (Switch): each token goes to its argmax expert, scaled by
+the router probability (the straight-through gradient path to the router),
+and tokens beyond an expert's capacity ``ceil(capacity_factor * N / E)``
+are *dropped* (contribute zero) exactly as in Switch — deterministic, no
+jitter.  The load-balance auxiliary loss ``E * sum_e f_e * P_e`` is exposed
+through a mutable ``losses`` collection; the training engines add
+``adapter.aux_loss(state)`` to the objective (ModelAdapter contract).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from distkeras_tpu.models.transformer import _SelfAttention
+
+__all__ = ["MoEFeedForward", "MoEEncoderBlock", "MoETransformerClassifier",
+           "expert_partition"]
+
+
+def expert_partition(num_experts: int, axis: str = "model"):
+    """``spec_fn`` for GSPMDEngine: shard the leading expert axis of every
+    ``[num_experts, ...]`` param leaf over ``axis``; everything else falls
+    through to the engine's default TP rule."""
+
+    def spec_fn(shape):
+        # >= 2-D only: the expert stacks (w1/b1/w2/b2, [E, ...]) all are,
+        # while 1-D leaves that merely *count* num_experts entries (router
+        # bias, a head bias when num_classes == num_experts) stay replicated.
+        if len(shape) >= 2 and shape[0] == num_experts:
+            return P(axis)
+        return None
+
+    return spec_fn
+
+
+class MoEFeedForward(nn.Module):
+    """Top-1 routed FFN bank with static-shape dispatch/combine einsums."""
+
+    dim: int
+    num_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    aux_weight: float = 1e-2
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        b, t, d = x.shape
+        e = self.num_experts
+        n = b * t
+        capacity = max(1, int(self.capacity_factor * n / e))
+        hidden = self.dim * self.mlp_ratio
+
+        tokens = x.reshape(n, d)
+        router_logits = nn.Dense(e, name="router")(tokens)  # [N, E]
+        gates = jax.nn.softmax(router_logits.astype(jnp.float32))
+        expert_idx = jnp.argmax(gates, axis=-1)  # [N]
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, E]
+        gate = (gates * onehot).sum(-1)  # [N] chosen-expert prob
+
+        # capacity: position of each token within its expert's queue
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
+        keep = (pos < capacity).astype(jnp.float32) * onehot
+        slot = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
+                              dtype=jnp.float32)  # [N, C]
+        dispatch = keep[:, :, None] * slot[:, None, :]  # [N, E, C]
+
+        # per-expert dense stacks [E, ...] — the leaves expert_partition shards
+        w1 = self.param("w1", nn.initializers.lecun_normal(), (e, d, hidden))
+        b1 = self.param("b1", nn.initializers.zeros, (e, hidden))
+        w2 = self.param("w2", nn.initializers.lecun_normal(), (e, hidden, d))
+        b2 = self.param("b2", nn.initializers.zeros, (e, d))
+
+        xin = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
+        h = nn.gelu(jnp.einsum("ecd,edh->ech", xin, w1.astype(x.dtype))
+                    + b1[:, None].astype(x.dtype))
+        out = jnp.einsum("ech,ehd->ecd", h, w2.astype(x.dtype)) \
+            + b2[:, None].astype(x.dtype)
+        combine = (dispatch * gate[:, None, None]).astype(x.dtype)
+        y = jnp.einsum("nec,ecd->nd", combine, out)
+
+        # Switch load balance: E * sum_e (token fraction)_e * (prob mass)_e;
+        # 1.0 at perfect balance.  Stored in a fixed-shape mutable variable
+        # (not sow: sow appends and would change the pytree structure across
+        # scanned steps).
+        frac = onehot.mean(0)
+        prob = gates.mean(0)
+        aux = self.variable("losses", "load_balance", lambda: jnp.zeros(()))
+        if self.is_mutable_collection("losses"):
+            aux.value = jnp.asarray(self.aux_weight * e * jnp.sum(frac * prob),
+                                    jnp.float32)
+        return y.reshape(b, t, d)
+
+
+class MoEEncoderBlock(nn.Module):
+    dim: int
+    heads: int
+    num_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    aux_weight: float = 1e-2
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        h = nn.LayerNorm()(x)
+        h = _SelfAttention(self.dim, self.heads, self.seq_axis)(h, training)
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = MoEFeedForward(self.dim, self.num_experts, self.mlp_ratio,
+                           self.capacity_factor, self.aux_weight)(h, training)
+        return x + h
+
+
+class MoETransformerClassifier(nn.Module):
+    """Token classifier with MoE encoder blocks ([batch, seq] int32 in)."""
+
+    vocab_size: int
+    num_classes: int = 2
+    dim: int = 64
+    heads: int = 2
+    num_layers: int = 2
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    aux_weight: float = 1e-2
+    max_len: int = 2048
+
+    @nn.compact
+    def __call__(self, tokens, training: bool = False):
+        tokens = tokens.astype(jnp.int32)
+        positions = jnp.arange(tokens.shape[1])
+        x = nn.Embed(self.vocab_size, self.dim, name="tok_embed")(tokens)
+        x = x + nn.Embed(self.max_len, self.dim, name="pos_embed")(positions)[None]
+        for i in range(self.num_layers):
+            x = MoEEncoderBlock(
+                self.dim, self.heads, self.num_experts, self.mlp_ratio,
+                self.capacity_factor, self.aux_weight, name=f"block_{i}",
+            )(x, training)
+        x = nn.LayerNorm()(x)
+        token_logits = nn.Dense(self.num_classes, name="head")(x)
+        return token_logits.sum(axis=1) / tokens.shape[1]

@@ -241,7 +241,7 @@ class WindowedEngine:
                 x_c = x
             out, new_ms = self.adapter.apply(p, ms, x_c, training=True, rng=sub)
             out = out.astype(jnp.float32)
-            loss = self.loss_fn(out, y)
+            loss = self.loss_fn(out, y) + self.adapter.aux_loss(new_ms)
             mets = (
                 jnp.stack([m(out, y) for m in self.metric_fns])
                 if self.metric_fns

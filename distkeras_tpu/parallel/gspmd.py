@@ -69,6 +69,7 @@ class GSPMDEngine(WindowedEngine):
         num_workers: Optional[int] = None,
         *,
         tp_shards: int = 1,
+        spec_fn=None,
         metrics: Sequence = ("accuracy",),
         compute_dtype: Optional[Any] = None,
         sync_model_state: bool = True,
@@ -79,6 +80,11 @@ class GSPMDEngine(WindowedEngine):
     ):
         devices = list(devices if devices is not None else jax.devices())
         self.tp_shards = int(tp_shards)
+        # Optional placement override: shape -> PartitionSpec, or None to
+        # fall through to the default Megatron-style rule.  This is how
+        # expert parallelism rides this engine (models/moe.expert_partition
+        # puts the leading [num_experts] axis on the model mesh axis).
+        self.spec_fn = spec_fn
         if len(devices) % self.tp_shards:
             raise ValueError(
                 f"tp_shards={tp_shards} does not divide device count {len(devices)}"
@@ -118,6 +124,17 @@ class GSPMDEngine(WindowedEngine):
         implies); this default puts matmul output channels — Dense/Conv
         kernels, embeddings — on the model axis, Megatron column-parallel
         style."""
+        if self.spec_fn is not None:
+            spec = self.spec_fn(tuple(shape))
+            if spec is not None:
+                for dim, name in zip(shape, spec):
+                    if name == TP_AXIS and dim % self.tp_shards:
+                        raise ValueError(
+                            f"spec_fn placed the model axis on a dim of size "
+                            f"{dim}, not divisible by tp_shards={self.tp_shards} "
+                            f"(leaf shape {tuple(shape)})"
+                        )
+                return spec
         if len(shape) >= 2 and shape[-1] % self.tp_shards == 0 and shape[-1] >= 2 * self.tp_shards:
             return P(*([None] * (len(shape) - 1)), TP_AXIS)
         return P()
