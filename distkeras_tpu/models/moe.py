@@ -24,6 +24,7 @@ through a mutable ``losses`` collection; the training engines add
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import flax.linen as nn
@@ -37,16 +38,23 @@ __all__ = ["MoEFeedForward", "MoEEncoderBlock", "MoETransformerClassifier",
            "expert_partition"]
 
 
-def expert_partition(num_experts: int, axis: str = "model"):
-    """``spec_fn`` for GSPMDEngine: shard the leading expert axis of every
-    ``[num_experts, ...]`` param leaf over ``axis``; everything else falls
-    through to the engine's default TP rule."""
+_EXPERT_PARAM_NAMES = frozenset({"w1", "b1", "w2", "b2"})
 
-    def spec_fn(shape):
-        # >= 2-D only: the expert stacks (w1/b1/w2/b2, [E, ...]) all are,
-        # while 1-D leaves that merely *count* num_experts entries (router
-        # bias, a head bias when num_classes == num_experts) stay replicated.
-        if len(shape) >= 2 and shape[0] == num_experts:
+
+def expert_partition(num_experts: int, axis: str = "model"):
+    """``spec_fn`` for GSPMDEngine: shard the leading expert axis of the
+    MoE FFN stacks over ``axis``; everything else falls through to the
+    engine's default TP rule.
+
+    Matches by param *path* (a ``MoEFeedForward`` module owning a
+    w1/b1/w2/b2 leaf) plus the ``[num_experts, ...]`` shape — a bare-shape
+    rule would also capture e.g. an attention ``(heads, head_dim, dim)``
+    kernel whenever ``heads == num_experts``."""
+
+    def spec_fn(shape, path=()):
+        in_moe = any("MoEFeedForward" in str(k) for k in path)
+        named = path and str(path[-1]) in _EXPERT_PARAM_NAMES
+        if in_moe and named and len(shape) >= 2 and shape[0] == num_experts:
             return P(axis)
         return None
 
@@ -67,7 +75,7 @@ class MoEFeedForward(nn.Module):
         b, t, d = x.shape
         e = self.num_experts
         n = b * t
-        capacity = max(1, int(self.capacity_factor * n / e))
+        capacity = max(1, math.ceil(self.capacity_factor * n / e))
         hidden = self.dim * self.mlp_ratio
 
         tokens = x.reshape(n, d)

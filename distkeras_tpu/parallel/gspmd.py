@@ -117,32 +117,45 @@ class GSPMDEngine(WindowedEngine):
         )
 
     # ------------------------------------------------------------- shardings
-    def _tp_spec(self, shape) -> P:
+    def _tp_spec(self, shape, path=()) -> P:
         """Shape-based TP placement: shard the last dim of any >=2-D leaf that
         splits evenly across the model axis.  Any placement is *correct* under
         GSPMD (the partitioner inserts whatever collectives the placement
         implies); this default puts matmul output channels — Dense/Conv
         kernels, embeddings — on the model axis, Megatron column-parallel
-        style."""
+        style.  ``spec_fn(shape, path)`` overrides leaf placement first;
+        ``path`` is the tuple of pytree key names so rules can match specific
+        params (a bare-shape rule cannot tell an expert stack from an
+        attention-heads kernel that coincidentally leads with num_experts)."""
         if self.spec_fn is not None:
-            spec = self.spec_fn(tuple(shape))
+            spec = self.spec_fn(tuple(shape), path)
             if spec is not None:
                 for dim, name in zip(shape, spec):
-                    if name == TP_AXIS and dim % self.tp_shards:
+                    on_model = name == TP_AXIS or (
+                        isinstance(name, tuple) and TP_AXIS in name
+                    )
+                    if on_model and dim % self.tp_shards:
                         raise ValueError(
                             f"spec_fn placed the model axis on a dim of size "
                             f"{dim}, not divisible by tp_shards={self.tp_shards} "
-                            f"(leaf shape {tuple(shape)})"
+                            f"(leaf shape {tuple(shape)}, path {path})"
                         )
                 return spec
         if len(shape) >= 2 and shape[-1] % self.tp_shards == 0 and shape[-1] >= 2 * self.tp_shards:
             return P(*([None] * (len(shape) - 1)), TP_AXIS)
         return P()
 
+    @staticmethod
+    def _key_names(path) -> tuple:
+        return tuple(
+            str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+            for k in path
+        )
+
     def _constrain_center(self, tree):
-        return jax.tree.map(
-            lambda x: lax.with_sharding_constraint(
-                x, NamedSharding(self.mesh, self._tp_spec(x.shape))
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, self._tp_spec(x.shape, self._key_names(path)))
             ),
             tree,
         )
@@ -151,14 +164,14 @@ class GSPMDEngine(WindowedEngine):
         """Per-worker trees ([num_workers, ...] leaves): workers axis on dim 0
         plus the TP spec of the per-worker shape."""
 
-        def one(x):
+        def one(path, x):
             if x.ndim >= 1 and x.shape[0] == self.num_workers:
-                spec = P(WORKER_AXIS, *self._tp_spec(x.shape[1:]))
+                spec = P(WORKER_AXIS, *self._tp_spec(x.shape[1:], self._key_names(path)))
             else:
                 spec = P()
             return lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
 
-        return jax.tree.map(one, tree)
+        return jax.tree_util.tree_map_with_path(one, tree)
 
     # ------------------------------------------------------------------ init
     def init_state(self, rng: jax.Array, sample_input) -> TrainState:

@@ -80,6 +80,8 @@ class Trainer:
         remat: bool = False,
         unroll=1,
         dispatch_epochs: int = 1,
+        pipeline_stages: int = 1,
+        pp_microbatches: Optional[int] = None,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -137,6 +139,12 @@ class Trainer:
         # tensor parallelism shards: >1 selects the GSPMD engine (param
         # leaves sharded over a 'model' mesh axis; any model, unmodified)
         self.tp_shards = int(tp_shards)
+        # pipeline parallelism stages: >1 selects the pipeline engine
+        # (microbatch ppermute pipeline over a 'stages' mesh axis; requires a
+        # staged adapter, models/staged.StagedTransformer, with num_stages ==
+        # pipeline_stages)
+        self.pipeline_stages = int(pipeline_stages)
+        self.pp_microbatches = pp_microbatches
         self.history: dict = {}
         self.training_time: float = 0.0
         self._t0: Optional[float] = None
@@ -193,7 +201,39 @@ class Trainer:
     ):
         adapter = as_adapter(self.master_model)
         feats, labels = self._load_columns(dataframe)
-        if self.tp_shards > 1:
+        if self.pipeline_stages > 1:
+            if self.tp_shards > 1 or self.seq_shards > 1:
+                raise ValueError(
+                    "pipeline_stages>1 composes with data parallelism only "
+                    "(not tp_shards/seq_shards in this release)"
+                )
+            if self.streaming or commit_schedule is not None:
+                raise ValueError(
+                    "pipeline_stages>1 is incompatible with streaming=True "
+                    "and with commit_schedule (staleness simulation)"
+                )
+            if getattr(adapter, "num_stages", None) != self.pipeline_stages:
+                raise ValueError(
+                    f"pipeline_stages={self.pipeline_stages} needs a staged "
+                    f"adapter with num_stages={self.pipeline_stages} (e.g. "
+                    "models.StagedTransformer); got "
+                    f"{type(self.master_model).__name__}"
+                )
+            from distkeras_tpu.parallel.pipeline import PipelineEngine
+
+            engine = PipelineEngine(
+                adapter,
+                self.loss,
+                self._effective_worker_optimizer(),
+                rule,
+                num_workers,
+                microbatches=self.pp_microbatches,
+                metrics=self.metrics,
+                compute_dtype=self.compute_dtype,
+                remat=self.remat,
+                unroll=self.unroll,
+            )
+        elif self.tp_shards > 1:
             if self.seq_shards > 1:
                 raise ValueError(
                     "tp_shards>1 (GSPMD engine) is incompatible with "
@@ -558,13 +598,15 @@ class DistributedTrainer(Trainer):
         remat: bool = False,
         unroll=1,
         dispatch_epochs: int = 1,
+        pipeline_stages: int = 1,
+        pp_microbatches: Optional[int] = None,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
             checkpoint_dir, checkpoint_every, resume, profile_dir, seq_shards,
             tp_shards, tensorboard_dir, streaming, remat, unroll,
-            dispatch_epochs,
+            dispatch_epochs, pipeline_stages, pp_microbatches,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port

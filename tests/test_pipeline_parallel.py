@@ -170,6 +170,43 @@ def test_pipeline_multi_epoch_dispatch_matches_loop():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_pipeline_through_trainer_api():
+    """Reference-style surface: DOWNPOUR(..., pipeline_stages=4) trains a
+    staged model through the DataFrame pipeline and returns a model whose
+    plain (sequential) predict works anywhere."""
+    import distkeras_tpu as dk
+
+    x, y, onehot = toy_text(n=256)
+    df = dk.from_numpy(x, onehot)
+    t = dk.DOWNPOUR(_staged(num_stages=4), loss="categorical_crossentropy",
+                    worker_optimizer=("adam", {"learning_rate": 2e-3}),
+                    num_workers=2, batch_size=16, num_epoch=12,
+                    communication_window=2, pipeline_stages=4)
+    trained = t.train(df)
+    h = t.get_history()["loss"]
+    assert h[-1] < h[0] * 0.7, h
+    preds = trained.predict(x)
+    assert preds.shape == (256, 2)
+    assert np.mean(np.argmax(preds, -1) == y) > 0.8
+
+
+def test_trainer_pipeline_kwarg_validation():
+    import distkeras_tpu as dk
+
+    x, _, onehot = toy_text(n=32)
+    df = dk.from_numpy(x, onehot)
+    t = dk.DOWNPOUR(_staged(num_stages=4), pipeline_stages=4, tp_shards=2,
+                    num_workers=2, batch_size=8, num_epoch=1)
+    with pytest.raises(ValueError, match="composes with data parallelism"):
+        t.train(df)
+    from distkeras_tpu.models import TextCNN
+    t2 = dk.DOWNPOUR(FlaxModel(TextCNN(vocab_size=50, num_classes=2)),
+                     pipeline_stages=4, num_workers=2, batch_size=8,
+                     num_epoch=1)
+    with pytest.raises(ValueError, match="staged adapter"):
+        t2.train(df)
+
+
 def test_pipeline_rejects_bad_configs():
     adapter = _staged(num_stages=3)
     with pytest.raises(ValueError, match="divide"):
