@@ -252,6 +252,46 @@ def _emit_error(message: str, metric: str = HEADLINE_METRIC):
     }))
 
 
+class _Deadman:
+    """Hard watchdog for mid-run tunnel death.
+
+    ``preflight`` bounds backend *init*, but the axon TPU tunnel can also
+    die mid-session (observed 2026-07-31: a full sweep hung 50 minutes
+    inside one config's compile until the outer timeout killed it with no
+    verdict for the remaining work).  A hung XLA call cannot be interrupted
+    from Python, so on expiry the watchdog honours the harness contract —
+    one JSON line per requested metric, always — by emitting error lines
+    for everything still pending and exiting the process.
+    """
+
+    def __init__(self):
+        self._timer = None
+
+    def arm(self, seconds: float, pending_metrics):
+        self.disarm()
+        pending = list(pending_metrics)
+
+        def fire():
+            for m in pending:
+                _emit_error(
+                    f"no result after {seconds:.0f}s — backend hung mid-run "
+                    "(TPU tunnel death?); remaining work abandoned", metric=m,
+                )
+            import sys
+
+            sys.stdout.flush()
+            os._exit(0)  # rc 0: the error lines ARE the verdict
+
+        self._timer = threading.Timer(seconds, fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def disarm(self):
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
 def _engine_for(config, num_workers=None):
     import jax
 
@@ -586,41 +626,65 @@ def main():
                         help="append a num_workers scaling-efficiency sweep")
     parser.add_argument("--streaming", action="store_true",
                         help="append a streaming-vs-in-memory comparison line")
+    parser.add_argument("--config-timeout", type=float, default=900.0,
+                        help="per-measurement deadman budget in seconds; on "
+                        "expiry every pending metric gets an error JSON line "
+                        "and the process exits (mid-run tunnel-death guard)")
     args = parser.parse_args()
+
+    configs = CONFIGS if args.config == "all" else [args.config]
+    metric_of = lambda c: (HEADLINE_METRIC if c == HEADLINE
+                           else f"{c}_samples_per_sec_per_chip")
+    pending = [metric_of(c) for c in configs]
+    if args.scaling:
+        pending.append(f"{HEADLINE}_scaling_efficiency")
+    if args.streaming:
+        pending.append(f"{HEADLINE}_streaming_overhead")
 
     backend = preflight()
     if "error" in backend:
-        _emit_error(f"backend unavailable after retries: {backend['error']}")
+        for m in pending:
+            _emit_error(f"backend unavailable after retries: {backend['error']}",
+                        metric=m)
         return
 
-    configs = CONFIGS if args.config == "all" else [args.config]
+    deadman = _Deadman()
+
     for config in configs:
+        deadman.arm(args.config_timeout, pending)
         try:
             result = run_config(config)
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
-            _emit_error(
-                f"{type(e).__name__}: {e}",
-                metric=HEADLINE_METRIC if config == HEADLINE
-                else f"{config}_samples_per_sec_per_chip",
-            )
+            _emit_error(f"{type(e).__name__}: {e}", metric=metric_of(config))
+            pending.pop(0)
             continue
+        finally:
+            deadman.disarm()
         if config == HEADLINE:
             result["metric"] = HEADLINE_METRIC
         print(json.dumps(result))
+        pending.pop(0)
 
     if args.scaling:
+        deadman.arm(args.config_timeout, pending)
         try:
             print(json.dumps(run_scaling()))
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
             _emit_error(f"{type(e).__name__}: {e}",
                         metric=f"{HEADLINE}_scaling_efficiency")
+        finally:
+            deadman.disarm()
+        pending.pop(0)
 
     if args.streaming:
+        deadman.arm(args.config_timeout, pending)
         try:
             print(json.dumps(run_streaming()))
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
             _emit_error(f"{type(e).__name__}: {e}",
                         metric=f"{HEADLINE}_streaming_overhead")
+        finally:
+            deadman.disarm()
 
 
 if __name__ == "__main__":

@@ -82,6 +82,7 @@ class Trainer:
         dispatch_epochs: int = 1,
         pipeline_stages: int = 1,
         pp_microbatches: Optional[int] = None,
+        tp_spec_fn: Optional[Any] = None,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -145,6 +146,16 @@ class Trainer:
         # pipeline_stages)
         self.pipeline_stages = int(pipeline_stages)
         self.pp_microbatches = pp_microbatches
+        # optional GSPMD leaf-placement override, (shape, path) ->
+        # PartitionSpec|None — e.g. models.expert_partition for MoE expert
+        # sharding over the model axis
+        self.tp_spec_fn = tp_spec_fn
+        if tp_spec_fn is not None and self.tp_shards <= 1:
+            raise ValueError(
+                "tp_spec_fn places leaves on the model mesh axis, which only "
+                "exists with tp_shards>1 (the GSPMD engine); without it the "
+                "override would be silently ignored"
+            )
         self.history: dict = {}
         self.training_time: float = 0.0
         self._t0: Optional[float] = None
@@ -248,6 +259,7 @@ class Trainer:
                 rule,
                 num_workers,
                 tp_shards=self.tp_shards,
+                spec_fn=self.tp_spec_fn,
                 metrics=self.metrics,
                 compute_dtype=self.compute_dtype,
                 commit_schedule=commit_schedule,
@@ -600,13 +612,14 @@ class DistributedTrainer(Trainer):
         dispatch_epochs: int = 1,
         pipeline_stages: int = 1,
         pp_microbatches: Optional[int] = None,
+        tp_spec_fn: Optional[Any] = None,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
             checkpoint_dir, checkpoint_every, resume, profile_dir, seq_shards,
             tp_shards, tensorboard_dir, streaming, remat, unroll,
-            dispatch_epochs, pipeline_stages, pp_microbatches,
+            dispatch_epochs, pipeline_stages, pp_microbatches, tp_spec_fn,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port

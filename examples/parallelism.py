@@ -10,6 +10,10 @@ any machine (swap to real chips by deleting the two config lines):
   3. tensor parallelism   — GSPMD engine over a (workers x model) mesh
   4. staleness simulation — per-worker commit periods (deterministic
                             asynchrony), here combined with TP
+  5. pipeline parallelism — microbatch ppermute pipeline over a
+                            (workers x stages) mesh (staged transformer)
+  6. expert parallelism   — Switch MoE with the expert stacks sharded over
+                            the model axis (GSPMD placement override)
 """
 
 import os
@@ -75,6 +79,30 @@ def main():
                   communication_window=4, tp_shards=2,
                   commit_schedule=[3, 4, 5, 6])
     report("DynSGD staleness sim + TP", t, t.train(df))
+
+    # 5. pipeline parallelism: staged transformer, 2 workers x 4 stages
+    from distkeras_tpu.models import StagedTransformer
+
+    t = dk.DOWNPOUR(StagedTransformer(vocab_size=64, num_classes=2, dim=32,
+                                      heads=2, num_stages=4,
+                                      blocks_per_stage=1, max_len=64),
+                    worker_optimizer=("adam", {"learning_rate": 2e-3}),
+                    num_workers=2, batch_size=16, num_epoch=10,
+                    communication_window=2, pipeline_stages=4)
+    report("pipeline 2w x 4 stages", t, t.train(tdf), tokens, ty)
+
+    # 6. expert parallelism: Switch MoE, experts sharded over the model axis
+    from distkeras_tpu.models import MoETransformerClassifier, expert_partition
+
+    t = dk.DOWNPOUR(FlaxModel(MoETransformerClassifier(
+                        vocab_size=64, num_classes=2, dim=32, heads=2,
+                        num_layers=1, num_experts=4, mlp_ratio=2,
+                        max_len=64)),
+                    worker_optimizer=("adam", {"learning_rate": 2e-3}),
+                    num_workers=4, batch_size=16, num_epoch=10,
+                    communication_window=2, tp_shards=2,
+                    tp_spec_fn=expert_partition(4))
+    report("Switch MoE 4w x 2experts", t, t.train(tdf), tokens, ty)
 
 
 if __name__ == "__main__":

@@ -33,7 +33,24 @@ def main(coordinator: str, num_processes: int, process_id: int,
     from distkeras_tpu.algorithms import Downpour
     from distkeras_tpu.models import MLP, FlaxModel
 
-    if engine_kind == "gspmd":
+    if engine_kind == "pipeline":
+        from distkeras_tpu.models import StagedTransformer
+        from distkeras_tpu.parallel.pipeline import PipelineEngine
+
+        num_workers = 4  # (workers=4, stages=2) grid over the 8 devices
+        adapter = StagedTransformer(
+            vocab_size=50, num_classes=2, dim=16, heads=2,
+            num_stages=2, blocks_per_stage=1, max_len=16,
+        )
+        engine = PipelineEngine(
+            adapter,
+            "categorical_crossentropy",
+            ("sgd", {"learning_rate": 0.05}),
+            Downpour(communication_window=2),
+            num_workers=num_workers,
+            microbatches=2,
+        )
+    elif engine_kind == "gspmd":
         from distkeras_tpu.parallel.gspmd import GSPMDEngine
 
         num_workers = 4  # (workers=4, model=2) grid over the 8 devices
@@ -58,11 +75,19 @@ def main(coordinator: str, num_processes: int, process_id: int,
         )
 
     rng = np.random.default_rng(0)  # same data on every process (SPMD)
-    x = rng.normal(size=(512, 8)).astype(np.float32)
-    y = (x @ rng.normal(size=(8,)) > 0).astype(np.int32)
+    if engine_kind == "pipeline":
+        # token-classification data for the staged transformer: the ppermute
+        # pipeline hops (and the stage-sharded param residency) cross the
+        # process boundary — the DCN analogue of the reference's workers
+        # living on different cluster machines
+        x = rng.integers(0, 50, size=(512, 16)).astype(np.int32)
+        y = ((x == 7).sum(1) > (x == 3).sum(1)).astype(np.int32)
+    else:
+        x = rng.normal(size=(512, 8)).astype(np.float32)
+        y = (x @ rng.normal(size=(8,)) > 0).astype(np.int32)
     onehot = np.eye(2, dtype=np.float32)[y]
     batch = 512 // (num_workers * 2 * 2)
-    xs = x.reshape(num_workers, 2, 2, batch, 8)
+    xs = x.reshape(num_workers, 2, 2, batch, -1)
     ys = onehot.reshape(num_workers, 2, 2, batch, 2)
 
     state = engine.init_state(jax.random.PRNGKey(0), x[:16])

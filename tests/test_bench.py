@@ -217,3 +217,53 @@ def test_scaling_sweep_schema(monkeypatch):
     assert 0 < out["value"] <= 1.0
     assert set(out["points_samples_per_sec_per_chip"]) == {str(c) for c in calls}
     json.dumps(out)
+
+
+def test_deadman_emits_pending_verdicts_and_exits():
+    """Mid-run tunnel death (observed 2026-07-31: a sweep hung 50 min inside
+    one config's compile): the deadman must turn a hang into one error JSON
+    line per pending metric and exit rc 0 — the lines ARE the verdict."""
+    import subprocess
+    import sys
+
+    code = (
+        "import time, bench\n"
+        "d = bench._Deadman()\n"
+        "d.arm(0.2, ['m1', 'm2'])\n"
+        "time.sleep(30)\n"  # simulated hung XLA call
+        "print('never reached')\n"
+    )
+    import os
+
+    root = os.path.dirname(os.path.abspath(bench.__file__))
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=25, cwd=root)
+    assert proc.returncode == 0
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+    assert [l["metric"] for l in lines] == ["m1", "m2"]
+    assert all("hung mid-run" in l["error"] for l in lines)
+    assert "never reached" not in proc.stdout
+
+
+def test_deadman_disarm_cancels():
+    """Subprocess like the sibling test: if disarm regresses, the stray
+    timer os._exit(0)s the host process — in-process that would silently
+    truncate the pytest run with rc 0."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import time, bench\n"
+        "d = bench._Deadman()\n"
+        "d.arm(0.05, ['m'])\n"
+        "d.disarm()\n"
+        "time.sleep(0.3)\n"
+        "print('survived')\n"
+    )
+    root = os.path.dirname(os.path.abspath(bench.__file__))
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=25, cwd=root)
+    assert proc.returncode == 0
+    assert "survived" in proc.stdout
+    assert "hung mid-run" not in proc.stdout

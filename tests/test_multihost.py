@@ -65,3 +65,10 @@ def test_four_process_downpour():
 def test_four_process_gspmd_tensor_parallel():
     # model axis (tp=2) and worker axis both cross process boundaries
     _run_processes(4, "gspmd")
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_parallel():
+    # the stages axis spans processes: ppermute activation hops and the
+    # stage-sharded block params both cross the process boundary
+    _run_processes(2, "pipeline")
