@@ -266,30 +266,43 @@ class _Deadman:
 
     def __init__(self):
         self._timer = None
+        self._lock = threading.Lock()
+        self._disarmed = False
 
     def arm(self, seconds: float, pending_metrics):
         self.disarm()
         pending = list(pending_metrics)
+        with self._lock:
+            self._disarmed = False
 
         def fire():
-            for m in pending:
-                _emit_error(
-                    f"no result after {seconds:.0f}s — backend hung mid-run "
-                    "(TPU tunnel death?); remaining work abandoned", metric=m,
-                )
-            import sys
+            # The lock + flag close the race with a measurement finishing at
+            # the deadline: whoever wins, exactly one verdict line per metric
+            # is printed (the main thread disarms before emitting its own).
+            with self._lock:
+                if self._disarmed:
+                    return
+                for m in pending:
+                    _emit_error(
+                        f"no result after {seconds:.0f}s — backend hung "
+                        "mid-run (TPU tunnel death?); remaining work "
+                        "abandoned", metric=m,
+                    )
+                import sys
 
-            sys.stdout.flush()
-            os._exit(0)  # rc 0: the error lines ARE the verdict
+                sys.stdout.flush()
+                os._exit(0)  # rc 0: the error lines ARE the verdict
 
         self._timer = threading.Timer(seconds, fire)
         self._timer.daemon = True
         self._timer.start()
 
     def disarm(self):
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
+        with self._lock:
+            self._disarmed = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
 
 
 def _engine_for(config, num_workers=None):
@@ -655,6 +668,7 @@ def main():
         try:
             result = run_config(config)
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
+            deadman.disarm()  # before emitting: exactly one line per metric
             _emit_error(f"{type(e).__name__}: {e}", metric=metric_of(config))
             pending.pop(0)
             continue
@@ -670,6 +684,7 @@ def main():
         try:
             print(json.dumps(run_scaling()))
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
+            deadman.disarm()
             _emit_error(f"{type(e).__name__}: {e}",
                         metric=f"{HEADLINE}_scaling_efficiency")
         finally:
@@ -681,6 +696,7 @@ def main():
         try:
             print(json.dumps(run_streaming()))
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
+            deadman.disarm()
             _emit_error(f"{type(e).__name__}: {e}",
                         metric=f"{HEADLINE}_streaming_overhead")
         finally:

@@ -62,11 +62,19 @@ def expert_partition(num_experts: int, axis: str = "model"):
 
 
 class MoEFeedForward(nn.Module):
-    """Top-1 routed FFN bank with static-shape dispatch/combine einsums."""
+    """Routed FFN bank with static-shape dispatch/combine einsums.
+
+    ``top_k=1`` is Switch (output scaled by the chosen expert's softmax
+    prob — the router's gradient path); ``top_k>1`` is GShard-style (each
+    token visits its top-k experts, combine weights are the top-k gates
+    renormalised to sum to 1).  Capacity is per expert,
+    ``ceil(capacity_factor * top_k * N / E)`` slots, filled rank-major so a
+    token's first-choice assignment always outranks any second choice."""
 
     dim: int
     num_experts: int
     mlp_ratio: int = 4
+    top_k: int = 1
     capacity_factor: float = 1.25
     aux_weight: float = 1e-2
 
@@ -74,23 +82,37 @@ class MoEFeedForward(nn.Module):
     def __call__(self, x, training: bool = False):
         b, t, d = x.shape
         e = self.num_experts
+        k = self.top_k
+        if not 1 <= k <= e:
+            raise ValueError(f"top_k={k} must be in [1, num_experts={e}]")
         n = b * t
-        capacity = max(1, math.ceil(self.capacity_factor * n / e))
+        capacity = max(1, math.ceil(self.capacity_factor * k * n / e))
         hidden = self.dim * self.mlp_ratio
 
         tokens = x.reshape(n, d)
         router_logits = nn.Dense(e, name="router")(tokens)  # [N, E]
         gates = jax.nn.softmax(router_logits.astype(jnp.float32))
-        expert_idx = jnp.argmax(gates, axis=-1)  # [N]
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, E]
-        gate = (gates * onehot).sum(-1)  # [N] chosen-expert prob
+        top_gates, top_idx = jax.lax.top_k(gates, k)  # [N, k] each
+        onehots = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [N, k, E]
 
-        # capacity: position of each token within its expert's queue
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
-        keep = (pos < capacity).astype(jnp.float32) * onehot
+        # capacity accounting, rank-major: every rank-0 assignment is queued
+        # before any rank-1 assignment, so second choices only consume slots
+        # first choices left free
+        oh_flat = jnp.moveaxis(onehots, 1, 0).reshape(k * n, e)  # [kN, E]
+        pos = (jnp.cumsum(oh_flat, axis=0) - 1.0) * oh_flat
+        keep = (pos < capacity).astype(jnp.float32) * oh_flat
         slot = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
-                              dtype=jnp.float32)  # [N, C]
-        dispatch = keep[:, :, None] * slot[:, None, :]  # [N, E, C]
+                              dtype=jnp.float32)  # [kN, C]
+        disp_ranks = (keep[:, :, None] * slot[:, None, :]).reshape(
+            k, n, e, capacity)
+        dispatch = disp_ranks.sum(0)  # [N, E, C]
+
+        # combine weights: Switch prob for k=1, renormalised top-k otherwise
+        if k == 1:
+            scale = top_gates  # [N, 1]
+        else:
+            scale = top_gates / top_gates.sum(-1, keepdims=True)
+        combine = jnp.einsum("rnec,nr->nec", disp_ranks, scale)
 
         # per-expert dense stacks [E, ...] — the leaves expert_partition shards
         w1 = self.param("w1", nn.initializers.lecun_normal(), (e, d, hidden))
@@ -103,14 +125,13 @@ class MoEFeedForward(nn.Module):
                     + b1[:, None].astype(x.dtype))
         out = jnp.einsum("ech,ehd->ecd", h, w2.astype(x.dtype)) \
             + b2[:, None].astype(x.dtype)
-        combine = (dispatch * gate[:, None, None]).astype(x.dtype)
-        y = jnp.einsum("nec,ecd->nd", combine, out)
+        y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
 
-        # Switch load balance: E * sum_e (token fraction)_e * (prob mass)_e;
+        # load balance (Switch form, rank-0 assignments): E * sum_e f_e * P_e;
         # 1.0 at perfect balance.  Stored in a fixed-shape mutable variable
         # (not sow: sow appends and would change the pytree structure across
         # scanned steps).
-        frac = onehot.mean(0)
+        frac = onehots[:, 0].mean(0)
         prob = gates.mean(0)
         aux = self.variable("losses", "load_balance", lambda: jnp.zeros(()))
         if self.is_mutable_collection("losses"):
@@ -124,6 +145,7 @@ class MoEEncoderBlock(nn.Module):
     heads: int
     num_experts: int
     mlp_ratio: int = 4
+    top_k: int = 1
     capacity_factor: float = 1.25
     aux_weight: float = 1e-2
     seq_axis: Optional[str] = None
@@ -135,7 +157,8 @@ class MoEEncoderBlock(nn.Module):
         x = x + h
         h = nn.LayerNorm()(x)
         h = MoEFeedForward(self.dim, self.num_experts, self.mlp_ratio,
-                           self.capacity_factor, self.aux_weight)(h, training)
+                           self.top_k, self.capacity_factor,
+                           self.aux_weight)(h, training)
         return x + h
 
 
@@ -149,6 +172,7 @@ class MoETransformerClassifier(nn.Module):
     num_layers: int = 2
     num_experts: int = 4
     mlp_ratio: int = 4
+    top_k: int = 1
     capacity_factor: float = 1.25
     aux_weight: float = 1e-2
     max_len: int = 2048
@@ -162,7 +186,8 @@ class MoETransformerClassifier(nn.Module):
         for i in range(self.num_layers):
             x = MoEEncoderBlock(
                 self.dim, self.heads, self.num_experts, self.mlp_ratio,
-                self.capacity_factor, self.aux_weight, name=f"block_{i}",
+                self.top_k, self.capacity_factor, self.aux_weight,
+                name=f"block_{i}",
             )(x, training)
         x = nn.LayerNorm()(x)
         token_logits = nn.Dense(self.num_classes, name="head")(x)

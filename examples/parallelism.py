@@ -1,7 +1,7 @@
 """Tour of the parallelism axes beyond plain data parallelism.
 
 The reference's only axis was Spark-task data parallelism; this example runs
-the rebuild's four extra axes on a faked 8-device CPU mesh so it works on
+the rebuild's six extra axes on a faked 8-device CPU mesh so it works on
 any machine (swap to real chips by deleting the two config lines):
 
   1. virtual workers      — more logical workers than devices (the analogue

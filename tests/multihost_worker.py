@@ -42,6 +42,15 @@ def main(coordinator: str, num_processes: int, process_id: int,
             vocab_size=50, num_classes=2, dim=16, heads=2,
             num_stages=2, blocks_per_stage=1, max_len=16,
         )
+        # Stage-major device order: row-major reshape to (workers=4,
+        # stages=2) then places stage 0 on devices 0-3 (process 0) and
+        # stage 1 on devices 4-7 (process 1), so EVERY ppermute stage hop
+        # crosses the process boundary.  The default id order would put
+        # each worker's stage pair inside one process and the pipeline
+        # axis would never touch the wire.
+        devs = sorted(jax.devices(), key=lambda d: d.id)
+        stage_major = [devs[w + s * num_workers]
+                       for w in range(num_workers) for s in range(2)]
         engine = PipelineEngine(
             adapter,
             "categorical_crossentropy",
@@ -49,6 +58,11 @@ def main(coordinator: str, num_processes: int, process_id: int,
             Downpour(communication_window=2),
             num_workers=num_workers,
             microbatches=2,
+            devices=stage_major,
+        )
+        stages_of = {d.process_index for d in engine.mesh.devices[0]}
+        assert len(stages_of) == num_processes, (
+            f"stage axis does not span processes: {stages_of}"
         )
     elif engine_kind == "gspmd":
         from distkeras_tpu.parallel.gspmd import GSPMDEngine
