@@ -681,26 +681,32 @@ def main():
 
     if args.scaling:
         deadman.arm(args.config_timeout, pending)
+        line = None
         try:
-            print(json.dumps(run_scaling()))
+            line = json.dumps(run_scaling())
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
             deadman.disarm()
             _emit_error(f"{type(e).__name__}: {e}",
                         metric=f"{HEADLINE}_scaling_efficiency")
         finally:
             deadman.disarm()
+        if line is not None:  # print only after disarm: one verdict per metric
+            print(line)
         pending.pop(0)
 
     if args.streaming:
         deadman.arm(args.config_timeout, pending)
+        line = None
         try:
-            print(json.dumps(run_streaming()))
+            line = json.dumps(run_streaming())
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
             deadman.disarm()
             _emit_error(f"{type(e).__name__}: {e}",
                         metric=f"{HEADLINE}_streaming_overhead")
         finally:
             deadman.disarm()
+        if line is not None:
+            print(line)
 
 
 if __name__ == "__main__":

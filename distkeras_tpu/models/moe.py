@@ -13,11 +13,14 @@ places the leading expert axis of every ``[num_experts, ...]`` leaf on the
 ``model`` mesh axis; the XLA partitioner inserts the token-shuffling
 collectives the placement implies (the all-to-all of a hand-written MoE).
 
-Routing is top-1 (Switch): each token goes to its argmax expert, scaled by
-the router probability (the straight-through gradient path to the router),
-and tokens beyond an expert's capacity ``ceil(capacity_factor * N / E)``
-are *dropped* (contribute zero) exactly as in Switch — deterministic, no
-jitter.  The load-balance auxiliary loss ``E * sum_e f_e * P_e`` is exposed
+Routing is top-k: ``top_k=1`` (the default) is Switch — each token goes to
+its argmax expert, scaled by the router probability (the gradient path to
+the router); ``top_k>1`` is GShard-style — each token visits its k best
+experts with renormalised gate weights.  Per-expert capacity is
+``ceil(capacity_factor * top_k * N / E)`` slots, filled rank-major (first
+choices always outrank second choices); assignments beyond capacity are
+*dropped* (contribute zero) — deterministic, no jitter.  The load-balance
+auxiliary loss ``E * sum_e f_e * P_e`` is exposed
 through a mutable ``losses`` collection; the training engines add
 ``adapter.aux_loss(state)`` to the objective (ModelAdapter contract).
 """

@@ -215,3 +215,26 @@ def test_pipeline_rejects_bad_configs():
         from distkeras_tpu.models import TextCNN
         PipelineEngine(FlaxModel(TextCNN(vocab_size=10, num_classes=2)),
                        "categorical_crossentropy", "sgd", Downpour(2))
+
+
+def test_pipeline_remat_trajectory_identical():
+    """GPipe + rematerialisation is the canonical memory recipe: remat must
+    not change the pipelined training math (same guarantee the dp engine
+    pins on ResNet-20 in test_fixes_r3)."""
+    x, _, onehot = toy_text()
+    xs, ys = _epoch_data(x, onehot, num_workers=2, n_windows=2, window=2,
+                         batch=8)
+    adapter = _staged(num_stages=4)
+
+    def run(remat):
+        eng = PipelineEngine(adapter, "categorical_crossentropy",
+                             ("sgd", {"learning_rate": 0.05}), Downpour(2),
+                             num_workers=2, metrics=(), remat=remat)
+        return _run_trajectory(eng, xs, ys)
+
+    center, losses = run(False)
+    center_r, losses_r = run(True)
+    np.testing.assert_allclose(losses_r, losses, rtol=1e-6, atol=1e-7)
+    for a, b in zip(jax.tree.leaves(center_r), jax.tree.leaves(center)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
