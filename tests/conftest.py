@@ -34,3 +34,25 @@ def toy_classification():
     onehot = np.zeros((n, 2), np.float32)
     onehot[np.arange(n), y] = 1.0
     return x, y, onehot
+
+
+def toy_text(n=128, seq=16, vocab=50, seed=0):
+    """Token-classification toy task shared by the parallelism test files:
+    class = whether token 7 appears more often than token 3 (needs the
+    whole sequence, so attention/pipelines must actually work)."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, vocab, size=(n, seq)).astype(np.int32)
+    y = ((x == 7).sum(1) > (x == 3).sum(1)).astype(np.int32)
+    return x, y, np.eye(2, dtype=np.float32)[y]
+
+
+def epoch_data(x, onehot, num_workers, n_windows, window, batch):
+    """Tile (x, onehot) into the engines' epoch layout
+    [workers, windows, window, batch, ...]."""
+    n_need = num_workers * n_windows * window * batch
+    reps = -(-n_need // len(x))
+    xs = np.tile(x, (reps, 1))[:n_need].reshape(
+        num_workers, n_windows, window, batch, -1)
+    ys = np.tile(onehot, (reps, 1))[:n_need].reshape(
+        num_workers, n_windows, window, batch, -1)
+    return xs, ys

@@ -23,12 +23,7 @@ from distkeras_tpu.models import (
 )
 from distkeras_tpu.parallel import GSPMDEngine, WindowedEngine
 
-
-def toy_text(n=128, seq=16, vocab=50, seed=0):
-    rng = np.random.default_rng(seed)
-    x = rng.integers(0, vocab, size=(n, seq)).astype(np.int32)
-    y = ((x == 7).sum(1) > (x == 3).sum(1)).astype(np.int32)
-    return x, y, np.eye(2, dtype=np.float32)[y]
+from conftest import epoch_data, toy_text
 
 
 def _moe(num_experts=4, capacity_factor=2.0):
@@ -37,16 +32,6 @@ def _moe(num_experts=4, capacity_factor=2.0):
         num_experts=num_experts, mlp_ratio=2, capacity_factor=capacity_factor,
         max_len=32,
     )
-
-
-def _epoch_data(x, onehot, num_workers, n_windows, window, batch):
-    n_need = num_workers * n_windows * window * batch
-    reps = -(-n_need // len(x))
-    xs = np.tile(x, (reps, 1))[:n_need].reshape(
-        num_workers, n_windows, window, batch, -1)
-    ys = np.tile(onehot, (reps, 1))[:n_need].reshape(
-        num_workers, n_windows, window, batch, -1)
-    return xs, ys
 
 
 def test_single_expert_moe_is_a_dense_ffn():
@@ -98,7 +83,7 @@ def test_aux_loss_lives_in_state_and_engine_adds_it():
 
 def test_moe_downpour_converges_dp():
     x, _, onehot = toy_text(n=256)
-    xs, ys = _epoch_data(x, onehot, num_workers=4, n_windows=2, window=2,
+    xs, ys = epoch_data(x, onehot, num_workers=4, n_windows=2, window=2,
                          batch=8)
     eng = WindowedEngine(FlaxModel(_moe()), "categorical_crossentropy",
                          ("adam", {"learning_rate": 2e-3}), Downpour(2),
@@ -116,7 +101,7 @@ def test_ep_matches_dp_trajectory_and_shards_experts():
     """2 workers x 4 expert shards == 2 workers unsharded, same seed/data;
     and the [E, ...] leaves really live split over the model axis."""
     x, _, onehot = toy_text(n=128)
-    xs, ys = _epoch_data(x, onehot, num_workers=2, n_windows=2, window=2,
+    xs, ys = epoch_data(x, onehot, num_workers=2, n_windows=2, window=2,
                          batch=8)
 
     def run(engine):
@@ -204,7 +189,7 @@ def test_top2_rank0_outranks_rank1_for_capacity():
 
 def test_moe_top2_converges():
     x, _, onehot = toy_text(n=256)
-    xs, ys = _epoch_data(x, onehot, num_workers=4, n_windows=2, window=2,
+    xs, ys = epoch_data(x, onehot, num_workers=4, n_windows=2, window=2,
                          batch=8)
     model = MoETransformerClassifier(
         vocab_size=50, num_classes=2, dim=32, heads=2, num_layers=1,

@@ -17,13 +17,7 @@ from distkeras_tpu.algorithms import Downpour, Sequential
 from distkeras_tpu.models import FlaxModel, StagedTransformer
 from distkeras_tpu.parallel import PP_AXIS, PipelineEngine, WindowedEngine
 
-
-def toy_text(n=128, seq=16, vocab=50, seed=0):
-    rng = np.random.default_rng(seed)
-    x = rng.integers(0, vocab, size=(n, seq)).astype(np.int32)
-    y = ((x == 7).sum(1) > (x == 3).sum(1)).astype(np.int32)
-    onehot = np.eye(2, dtype=np.float32)[y]
-    return x, y, onehot
+from conftest import epoch_data, toy_text
 
 
 def _staged(num_stages=4, per_stage=1):
@@ -31,16 +25,6 @@ def _staged(num_stages=4, per_stage=1):
         vocab_size=50, num_classes=2, dim=32, heads=2,
         num_stages=num_stages, blocks_per_stage=per_stage, max_len=64,
     )
-
-
-def _epoch_data(x, onehot, num_workers, n_windows, window, batch):
-    n_need = num_workers * n_windows * window * batch
-    reps = -(-n_need // len(x))
-    xs = np.tile(x, (reps, 1))[:n_need]
-    ys = np.tile(onehot, (reps, 1))[:n_need]
-    xs = xs.reshape(num_workers, n_windows, window, batch, -1)
-    ys = ys.reshape(num_workers, n_windows, window, batch, -1)
-    return xs, ys
 
 
 def _run_trajectory(engine, xs, ys, epochs=2):
@@ -61,7 +45,7 @@ def test_pipeline_forward_loss_matches_sequential():
     eng = PipelineEngine(adapter, "categorical_crossentropy",
                          ("sgd", {"learning_rate": 0.0}), Sequential(),
                          num_workers=2, metrics=())
-    xs, ys = _epoch_data(x, onehot, num_workers=2, n_windows=1, window=2, batch=8)
+    xs, ys = epoch_data(x, onehot, num_workers=2, n_windows=1, window=2, batch=8)
     center, losses = _run_trajectory(eng, xs, ys, epochs=1)
 
     # host-side sequential forward on the same params and batches
@@ -81,7 +65,7 @@ def test_pipeline_trajectory_matches_dp(microbatches):
     """2 workers x 4 stages == 2 workers sequential, same staged model, same
     seed, same data: pipelining must not change the training math."""
     x, _, onehot = toy_text()
-    xs, ys = _epoch_data(x, onehot, num_workers=2, n_windows=2, window=2, batch=8)
+    xs, ys = epoch_data(x, onehot, num_workers=2, n_windows=2, window=2, batch=8)
 
     adapter = _staged(num_stages=4)
     pp = PipelineEngine(adapter, "categorical_crossentropy",
@@ -126,7 +110,7 @@ def test_pipeline_stage_params_are_stage_sharded():
 def test_pipeline_downpour_converges():
     """dp x pp windowed async training learns the toy task."""
     x, _, onehot = toy_text(n=256)
-    xs, ys = _epoch_data(x, onehot, num_workers=2, n_windows=4, window=2, batch=8)
+    xs, ys = epoch_data(x, onehot, num_workers=2, n_windows=4, window=2, batch=8)
     adapter = _staged(num_stages=4)
     eng = PipelineEngine(adapter, "categorical_crossentropy",
                          ("adam", {"learning_rate": 2e-3}), Downpour(2),
@@ -143,7 +127,7 @@ def test_pipeline_downpour_converges():
 def test_pipeline_multi_epoch_dispatch_matches_loop():
     """run_epochs (one dispatch) == N run_epoch calls, on the pipeline too."""
     x, _, onehot = toy_text()
-    xs, ys = _epoch_data(x, onehot, num_workers=4, n_windows=2, window=2, batch=8)
+    xs, ys = epoch_data(x, onehot, num_workers=4, n_windows=2, window=2, batch=8)
     adapter = _staged(num_stages=2)
 
     def make():
@@ -222,7 +206,7 @@ def test_pipeline_remat_trajectory_identical():
     not change the pipelined training math (same guarantee the dp engine
     pins on ResNet-20 in test_fixes_r3)."""
     x, _, onehot = toy_text()
-    xs, ys = _epoch_data(x, onehot, num_workers=2, n_windows=2, window=2,
+    xs, ys = epoch_data(x, onehot, num_workers=2, n_windows=2, window=2,
                          batch=8)
     adapter = _staged(num_stages=4)
 
