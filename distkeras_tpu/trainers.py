@@ -218,10 +218,10 @@ class Trainer:
                     "pipeline_stages>1 composes with data parallelism only "
                     "(not tp_shards/seq_shards in this release)"
                 )
-            if self.streaming or commit_schedule is not None:
+            if commit_schedule is not None:
                 raise ValueError(
-                    "pipeline_stages>1 is incompatible with streaming=True "
-                    "and with commit_schedule (staleness simulation)"
+                    "pipeline_stages>1 is incompatible with commit_schedule "
+                    "(the staleness simulation dispatches per step)"
                 )
             if getattr(adapter, "num_stages", None) != self.pipeline_stages:
                 raise ValueError(

@@ -10,14 +10,7 @@ from distkeras_tpu.frame import from_numpy
 from distkeras_tpu.models import FlaxModel, TransformerClassifier
 
 
-def toy_text(n=256, seq=32, vocab=50, seed=0):
-    """Class = whether token id 7 appears more than id 3 (needs attention over
-    the whole sequence)."""
-    rng = np.random.default_rng(seed)
-    x = rng.integers(0, vocab, size=(n, seq)).astype(np.int32)
-    y = ((x == 7).sum(1) > (x == 3).sum(1)).astype(np.int32)
-    onehot = np.eye(2, dtype=np.float32)[y]
-    return x, y, onehot
+from conftest import toy_text  # noqa: E402  (shared toy task; seq=32 here)
 
 
 def _model(seq_axis=None):
@@ -32,7 +25,7 @@ def test_sp_forward_matches_unsharded():
     from distkeras_tpu.parallel.engine import WindowedEngine
     from distkeras_tpu.algorithms import Downpour
 
-    x, _, onehot = toy_text(n=8)
+    x, _, onehot = toy_text(n=8, seq=32)
     sp = WindowedEngine(_model("seq"), "categorical_crossentropy", "sgd",
                         Downpour(2), num_workers=2, seq_shards=2)
     state = sp.init_state(jax.random.PRNGKey(0), x[:4])
@@ -55,7 +48,7 @@ def test_sp_forward_matches_unsharded():
 
 
 def test_downpour_with_sequence_parallelism_converges():
-    x, y, onehot = toy_text()
+    x, y, onehot = toy_text(n=256, seq=32)
     df = from_numpy(x, onehot)
     t = dk.DOWNPOUR(_model("seq"), loss="categorical_crossentropy",
                     worker_optimizer=("adam", {"learning_rate": 3e-3}),
@@ -72,7 +65,7 @@ def test_sp_matches_dp_only_training():
     """4 workers x 2 seq shards must give (numerically) the same training
     trajectory as 4 workers unsharded — sequence parallelism is an
     implementation detail, not a semantics change."""
-    x, _, onehot = toy_text(n=128)
+    x, _, onehot = toy_text(n=128, seq=32)
     df = from_numpy(x, onehot)
 
     def run(seq_shards, seq_axis):
@@ -92,7 +85,7 @@ def test_sp_matches_dp_only_training():
 
 def test_sp_trained_model_predicts_without_mesh():
     """The returned model must be usable for plain inference (non-SP twin)."""
-    x, y, onehot = toy_text(n=128)
+    x, y, onehot = toy_text(n=128, seq=32)
     df = from_numpy(x, onehot)
     t = dk.DOWNPOUR(_model("seq"), loss="categorical_crossentropy",
                     worker_optimizer=("adam", {"learning_rate": 3e-3}),
