@@ -14,7 +14,11 @@ from distkeras_tpu.models.moe import (
     expert_partition,
 )
 from distkeras_tpu.models.staged import StagedTransformer
-from distkeras_tpu.models.transformer import TransformerClassifier, TransformerEncoderBlock
+from distkeras_tpu.models.transformer import (
+    TransformerClassifier,
+    TransformerEncoderBlock,
+    TransformerLM,
+)
 from distkeras_tpu.models.zoo import CIFARCNN, MLP, MNISTCNN, ResNet20, TextCNN
 
 __all__ = [
@@ -30,6 +34,7 @@ __all__ = [
     "TextCNN",
     "TransformerClassifier",
     "TransformerEncoderBlock",
+    "TransformerLM",
     "StagedTransformer",
     "MoEFeedForward",
     "MoEEncoderBlock",

@@ -35,6 +35,12 @@ class ModelAdapter:
     #: post-activation probabilities (Keras models with softmax heads).
     outputs_logits: bool = True
 
+    #: model emits per-token outputs trained against per-token labels
+    #: (language models).  The engines shard the label array exactly like
+    #: the input array — under sequence parallelism each shard keeps its
+    #: block's targets.
+    per_token_labels: bool = False
+
     def init(self, rng: jax.Array, sample_input: np.ndarray) -> Tuple[Any, Any]:
         raise NotImplementedError
 
@@ -62,6 +68,11 @@ class FlaxModel(ModelAdapter):
 
     module: Any
     outputs_logits: bool = True
+
+    @property
+    def per_token_labels(self) -> bool:
+        """Inherited from the wrapped module (TransformerLM sets it)."""
+        return bool(getattr(self.module, "per_token_labels", False))
 
     def init(self, rng, sample_input):
         variables = self.module.init(rng, jnp.asarray(sample_input), training=False)

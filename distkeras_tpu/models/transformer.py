@@ -21,7 +21,7 @@ from jax import lax
 
 from distkeras_tpu.parallel.ring import attention, ring_attention
 
-__all__ = ["TransformerClassifier", "TransformerEncoderBlock"]
+__all__ = ["TransformerClassifier", "TransformerEncoderBlock", "TransformerLM"]
 
 
 class _SelfAttention(nn.Module):
@@ -66,6 +66,61 @@ class TransformerEncoderBlock(nn.Module):
         return x + h
 
 
+def _encode_tokens(tokens, *, vocab_size, dim, heads, num_layers, max_len,
+                   seq_axis, causal, dropout, training):
+    """Shared classifier/LM trunk: token + (block-offset) positional
+    embeddings, encoder-block stack, final LayerNorm.  Must be called from
+    inside an ``@nn.compact`` ``__call__`` — the modules it instantiates
+    attach to the caller's scope (flat param names)."""
+    tokens = tokens.astype(jnp.int32)
+    block_len = tokens.shape[1]
+    offset = lax.axis_index(seq_axis) * block_len if seq_axis is not None else 0
+    positions = offset + jnp.arange(block_len)
+    x = nn.Embed(vocab_size, dim, name="tok_embed")(tokens)
+    x = x + nn.Embed(max_len, dim, name="pos_embed")(positions)[None]
+    for i in range(num_layers):
+        x = TransformerEncoderBlock(
+            dim, heads, seq_axis=seq_axis, causal=causal,
+            dropout=dropout, name=f"block_{i}",
+        )(x, training)
+    return nn.LayerNorm()(x)
+
+
+class TransformerLM(nn.Module):
+    """Causal language model over ``[batch, seq(block)]`` int32 tokens,
+    emitting per-token next-token logits ``[batch, seq(block), vocab]``.
+
+    Long-context first-class: with ``seq_axis`` set (inside ``shard_map``
+    over that axis), attention runs as *causal ring attention* — each
+    device holds one sequence block, K/V blocks rotate around the ring —
+    and the per-token logits (and their integer labels, sharded by the
+    engine) stay block-local, so memory per device is O(seq/shards).
+    Train with ``loss="token_crossentropy"`` /
+    ``metrics=("token_accuracy",)``.
+    """
+
+    vocab_size: int
+    dim: int = 128
+    heads: int = 4
+    num_layers: int = 2
+    max_len: int = 2048
+    seq_axis: Optional[str] = None
+    dropout: float = 0.0
+
+    #: engines shard the label array like the token array (per-token labels)
+    per_token_labels = True
+
+    @nn.compact
+    def __call__(self, tokens, training: bool = False):
+        x = _encode_tokens(
+            tokens, vocab_size=self.vocab_size, dim=self.dim, heads=self.heads,
+            num_layers=self.num_layers, max_len=self.max_len,
+            seq_axis=self.seq_axis, causal=True, dropout=self.dropout,
+            training=training,
+        )
+        return nn.Dense(self.vocab_size, name="lm_head")(x)
+
+
 class TransformerClassifier(nn.Module):
     """Token classifier over [batch, seq(block)] int32 inputs.
 
@@ -86,23 +141,17 @@ class TransformerClassifier(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, training: bool = False):
-        tokens = tokens.astype(jnp.int32)
         block_len = tokens.shape[1]
-        if self.seq_axis is not None:
-            offset = lax.axis_index(self.seq_axis) * block_len
-            seq_total = block_len * lax.axis_size(self.seq_axis)
-        else:
-            offset = 0
-            seq_total = block_len
-        positions = offset + jnp.arange(block_len)
-        x = nn.Embed(self.vocab_size, self.dim, name="tok_embed")(tokens)
-        x = x + nn.Embed(self.max_len, self.dim, name="pos_embed")(positions)[None]
-        for i in range(self.num_layers):
-            x = TransformerEncoderBlock(
-                self.dim, self.heads, seq_axis=self.seq_axis, causal=self.causal,
-                dropout=self.dropout, name=f"block_{i}",
-            )(x, training)
-        x = nn.LayerNorm()(x)
+        seq_total = (
+            block_len * lax.axis_size(self.seq_axis)
+            if self.seq_axis is not None else block_len
+        )
+        x = _encode_tokens(
+            tokens, vocab_size=self.vocab_size, dim=self.dim, heads=self.heads,
+            num_layers=self.num_layers, max_len=self.max_len,
+            seq_axis=self.seq_axis, causal=self.causal, dropout=self.dropout,
+            training=training,
+        )
         token_logits = nn.Dense(self.num_classes, name="head")(x)  # [b, blk, C]
         logits = token_logits.sum(axis=1) / seq_total
         if self.seq_axis is not None:

@@ -60,11 +60,32 @@ def _mae(preds, labels):
     return jnp.mean(jnp.abs(preds - labels))
 
 
+def _token_crossentropy(from_logits: bool):
+    """Per-token LM crossentropy: preds [B, T, V] (logits), labels int [B, T].
+    Mean over batch and tokens — under sequence parallelism each shard's
+    local mean over its equal-size block makes the shard-averaged gradient
+    exactly the global-mean gradient (see WindowedEngine._sync_grads)."""
+
+    def loss(preds, labels):
+        labels = jnp.asarray(labels).astype(jnp.int32)
+        if from_logits:
+            return optax.softmax_cross_entropy_with_integer_labels(
+                preds, labels
+            ).mean()
+        p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+        picked = jnp.take_along_axis(p, labels[..., None], axis=-1)[..., 0]
+        return -jnp.log(picked).mean()
+
+    return loss
+
+
 def get_loss(spec, from_logits: bool = True) -> Callable:
     """Resolve a Keras-style loss string (or pass through a callable)."""
     if callable(spec):
         return spec
     name = str(spec).lower()
+    if name in ("token_crossentropy", "lm_crossentropy"):
+        return _token_crossentropy(from_logits)
     if name in ("categorical_crossentropy", "sparse_categorical_crossentropy", "crossentropy"):
         return _categorical_crossentropy(from_logits)
     if name in ("binary_crossentropy",):

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["accuracy", "get_metric"]
+__all__ = ["accuracy", "token_accuracy", "get_metric"]
 
 
 def accuracy(preds, labels):
@@ -23,10 +23,19 @@ def accuracy(preds, labels):
     return jnp.mean((pred_idx == label_idx).astype(jnp.float32))
 
 
+def token_accuracy(preds, labels):
+    """Next-token top-1 accuracy: preds [B, T, V], labels int [B, T]."""
+    preds = jnp.asarray(preds)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    return jnp.mean((jnp.argmax(preds, axis=-1) == labels).astype(jnp.float32))
+
+
 def get_metric(spec):
     if callable(spec):
         return spec
     name = str(spec).lower()
     if name in ("accuracy", "acc", "categorical_accuracy"):
         return accuracy
+    if name in ("token_accuracy", "lm_accuracy"):
+        return token_accuracy
     raise ValueError(f"unknown metric {spec!r}")

@@ -307,14 +307,32 @@ class WindowedEngine:
         mean = jax.tree.map(lambda x: ctx.psum(x) / self.num_workers, model_state)
         return tree_where(ctx.mask, mean, model_state)
 
+    @property
+    def _per_token(self) -> bool:
+        """Model emits per-token outputs with per-token labels (LMs —
+        ``ModelAdapter.per_token_labels``): labels shard over the seq axis
+        with the tokens, and per-shard loss/metric values are block-local
+        (not replicated) so epoch stats need a seq-axis mean."""
+        return bool(self.adapter.per_token_labels)
+
+    def _reduce_seq_stats(self, *stats):
+        """Average block-local stats over the seq axis (no-op when outputs
+        are already replicated across it — the classifier's psum-pooled
+        logits)."""
+        if self.seq_axis is not None and self._per_token:
+            stats = tuple(lax.pmean(s, self.seq_axis) for s in stats)
+        return stats if len(stats) > 1 else stats[0]
+
     def _data_specs(self, xs_ndim: int):
         """Partition specs for (xs, ys): worker axis leading; for sequence
-        parallelism the sequence (last) axis of xs also shards."""
+        parallelism the sequence (last) axis of xs also shards — and so do
+        the labels when the model declares them per-token (language models:
+        labels mirror the token array, each shard keeps its block's
+        targets)."""
         if self.seq_axis is not None:
             xs_spec = P(self.axis, *([None] * (xs_ndim - 2)), self.seq_axis)
-        else:
-            xs_spec = P(self.axis)
-        return xs_spec, P(self.axis)
+            return xs_spec, (xs_spec if self._per_token else P(self.axis))
+        return P(self.axis), P(self.axis)
 
     def _window_fn(self, do_commit: bool, window: int):
         """Build the one-worker window body: inner scan of local steps, then
@@ -383,6 +401,7 @@ class WindowedEngine:
             # end-of-epoch reduction over virtual workers + mesh devices.
             losses = lax.psum(jnp.sum(losses, axis=1), self.axis) / self.num_workers
             mets = lax.psum(jnp.sum(mets, axis=1), self.axis) / self.num_workers
+            losses, mets = self._reduce_seq_stats(losses, mets)
             return center_params, center_rule, local, losses, mets
 
         xs_spec, ys_spec = self._data_specs(xs_ndim)
@@ -546,6 +565,7 @@ class WindowedEngine:
             # losses: [n_steps, v] — one end-of-epoch reduction (see the
             # windowed epoch fn for why this is not done per step).
             losses = lax.psum(jnp.sum(losses, axis=1), self.axis) / self.num_workers
+            losses = self._reduce_seq_stats(losses)
             return center_params, center_rule, local, losses
 
         xs_spec, ys_spec = self._data_specs(xs_ndim)
