@@ -13,7 +13,7 @@ from distkeras_tpu.models.moe import (
     MoETransformerClassifier,
     expert_partition,
 )
-from distkeras_tpu.models.staged import StagedTransformer
+from distkeras_tpu.models.staged import StagedLM, StagedTransformer
 from distkeras_tpu.models.transformer import (
     TransformerClassifier,
     TransformerEncoderBlock,
@@ -36,6 +36,7 @@ __all__ = [
     "TransformerEncoderBlock",
     "TransformerLM",
     "StagedTransformer",
+    "StagedLM",
     "MoEFeedForward",
     "MoEEncoderBlock",
     "MoETransformerClassifier",

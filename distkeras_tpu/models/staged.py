@@ -34,7 +34,7 @@ from jax import lax
 from distkeras_tpu.models.adapter import ModelAdapter
 from distkeras_tpu.models.transformer import TransformerEncoderBlock
 
-__all__ = ["StagedTransformer"]
+__all__ = ["StagedTransformer", "StagedLM"]
 
 
 class _Embed(nn.Module):
@@ -58,6 +58,15 @@ class _Head(nn.Module):
         x = nn.LayerNorm()(x)
         token_logits = nn.Dense(self.num_classes, name="out")(x)
         return token_logits.sum(axis=1) / x.shape[1]
+
+
+class _LMHead(nn.Module):
+    vocab_size: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm()(x)
+        return nn.Dense(self.vocab_size, name="out")(x)  # [b, seq, vocab]
 
 
 @dataclasses.dataclass
@@ -134,3 +143,28 @@ class StagedTransformer(ModelAdapter):
 
         h, _ = lax.scan(body, h, params["blocks"])
         return self.head(params["head"], h), state
+
+
+@dataclasses.dataclass
+class StagedLM(StagedTransformer):
+    """Pipeline-staged causal language model: the GPipe-for-LM shape.
+
+    Same staged layout as :class:`StagedTransformer` (embed replicated,
+    homogeneous block stages stacked ``[S, per_stage, ...]``, head
+    replicated) with causal blocks and a per-token vocab head — trained
+    with ``loss="token_crossentropy"``; the engines shard the integer
+    label array like the tokens (``per_token_labels``).  Output width is
+    ``vocab_size``; the inherited ``num_classes`` field does not apply.
+    """
+
+    per_token_labels: bool = True
+
+    def __post_init__(self):
+        if self.num_classes != type(self).num_classes:
+            raise ValueError(
+                "StagedLM outputs vocab_size-wide logits; num_classes does "
+                "not apply — did you mean StagedTransformer?"
+            )
+        super().__post_init__()
+        self._block = TransformerEncoderBlock(self.dim, self.heads, causal=True)
+        self._head = _LMHead(self.vocab_size)

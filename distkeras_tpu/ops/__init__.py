@@ -1,8 +1,8 @@
 """Compute ops: losses, metrics, optimizer registry (all jit-safe)."""
 
 from distkeras_tpu.ops.losses import get_loss
-from distkeras_tpu.ops.metrics import accuracy, get_metric
+from distkeras_tpu.ops.metrics import accuracy, get_metric, token_accuracy
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.ops.pooling import max_pool
 
-__all__ = ["get_loss", "get_metric", "get_optimizer", "accuracy", "max_pool"]
+__all__ = ["get_loss", "get_metric", "get_optimizer", "accuracy", "token_accuracy", "max_pool"]
