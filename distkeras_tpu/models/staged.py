@@ -92,8 +92,14 @@ class StagedTransformer(ModelAdapter):
 
     def __post_init__(self):
         self._embed = _Embed(self.vocab_size, self.dim, self.max_len)
-        self._block = TransformerEncoderBlock(self.dim, self.heads)
-        self._head = _Head(self.num_classes)
+        self._block = self._make_block()
+        self._head = self._make_head()
+
+    def _make_block(self):
+        return TransformerEncoderBlock(self.dim, self.heads)
+
+    def _make_head(self):
+        return _Head(self.num_classes)
 
     # ------------------------------------------------------------------ init
     def init(self, rng: jax.Array, sample_input) -> Tuple[Any, Any]:
@@ -166,5 +172,9 @@ class StagedLM(StagedTransformer):
                 "not apply — did you mean StagedTransformer?"
             )
         super().__post_init__()
-        self._block = TransformerEncoderBlock(self.dim, self.heads, causal=True)
-        self._head = _LMHead(self.vocab_size)
+
+    def _make_block(self):
+        return TransformerEncoderBlock(self.dim, self.heads, causal=True)
+
+    def _make_head(self):
+        return _LMHead(self.vocab_size)

@@ -102,16 +102,6 @@ def test_lm_sp_matches_dp_trajectory():
                                    rtol=5e-3, atol=5e-4)
 
 
-def lm_epoch_data(x, y, num_workers, n_windows, window, batch):
-    n_need = num_workers * n_windows * window * batch
-    reps = -(-n_need // len(x))
-    xs = np.tile(x, (reps, 1))[:n_need].reshape(
-        num_workers, n_windows, window, batch, -1)
-    ys = np.tile(y, (reps, 1))[:n_need].reshape(
-        num_workers, n_windows, window, batch, -1)
-    return xs, ys
-
-
 def test_staged_lm_pipeline_matches_sequential_dp():
     """GPipe-for-LM: 2 workers x 4 stages == 2 workers sequential on the
     staged causal LM — per-token outputs stream through the pipeline's
@@ -121,7 +111,9 @@ def test_staged_lm_pipeline_matches_sequential_dp():
     from distkeras_tpu.parallel import PipelineEngine, WindowedEngine
 
     x, y = lm_data(n=128)
-    xs, ys = lm_epoch_data(x, y, num_workers=2, n_windows=2, window=2, batch=8)
+    from conftest import epoch_data
+
+    xs, ys = epoch_data(x, y, num_workers=2, n_windows=2, window=2, batch=8)
     adapter = StagedLM(vocab_size=23, dim=32, heads=2, num_stages=4,
                        blocks_per_stage=1, max_len=64)
 
