@@ -15,7 +15,7 @@ follows.
 __version__ = "0.1.0"
 
 from distkeras_tpu import frame, utils
-from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
+from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator, PerplexityEvaluator
 from distkeras_tpu.frame import (
     DataFrame,
     Row,
@@ -72,6 +72,7 @@ __all__ = [
     "ModelPredictor",
     "AccuracyEvaluator",
     "LossEvaluator",
+    "PerplexityEvaluator",
     "LabelIndexTransformer",
     "OneHotTransformer",
     "MinMaxTransformer",

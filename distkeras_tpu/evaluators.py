@@ -11,7 +11,7 @@ import numpy as np
 
 from distkeras_tpu.frame import DataFrame
 
-__all__ = ["Evaluator", "AccuracyEvaluator", "LossEvaluator"]
+__all__ = ["Evaluator", "AccuracyEvaluator", "LossEvaluator", "PerplexityEvaluator"]
 
 
 class Evaluator:
@@ -66,3 +66,43 @@ class LossEvaluator(Evaluator):
         preds = jnp.asarray(dataframe.matrix(self.prediction_col))
         labels = jnp.asarray(dataframe.matrix(self.label_col))
         return float(self.loss_fn(preds, labels))
+
+
+class PerplexityEvaluator(Evaluator):
+    """Per-token perplexity for language models (extension beyond the
+    reference set): ``exp(mean NLL of the true next tokens)``.
+
+    Expects a prediction column of per-token distributions ``[seq, vocab]``
+    (what ``ModelPredictor`` emits for a ``TransformerLM``/``StagedLM`` —
+    softmax probabilities) and an integer label column ``[seq]``.
+    """
+
+    def __init__(self, prediction_col: str = "prediction",
+                 label_col: str = "label", from_logits: bool = False,
+                 eps: float = 1e-9):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+        self.from_logits = from_logits
+        self.eps = eps
+
+    def evaluate(self, dataframe: DataFrame) -> float:
+        preds = dataframe.matrix(self.prediction_col, dtype=np.float64)
+        labels = dataframe.matrix(self.label_col, dtype=np.int64)
+        if preds.ndim != 3:
+            raise ValueError(
+                f"perplexity needs per-token distributions [N, seq, vocab]; "
+                f"got prediction shape {preds.shape}"
+            )
+        if self.from_logits:
+            z = preds - preds.max(-1, keepdims=True)
+            ez = np.exp(z)
+            preds = ez / ez.sum(-1, keepdims=True)
+        elif preds.min() < 0.0 or preds.max() > 1.0 + 1e-6:
+            raise ValueError(
+                "prediction column holds values outside [0, 1] — pass "
+                "from_logits=True for raw logits (clipping them would report "
+                "a deceptively low perplexity)"
+            )
+        picked = np.take_along_axis(preds, labels[..., None], axis=-1)[..., 0]
+        nll = -np.log(np.clip(picked, self.eps, 1.0))
+        return float(np.exp(nll.mean()))
