@@ -102,6 +102,16 @@ def test_lm_sp_matches_dp_trajectory():
                                    rtol=5e-3, atol=5e-4)
 
 
+def _run_two_epochs(engine, xs, ys):
+    xs_d, ys_d = engine.shard_batches(xs, ys)
+    state = engine.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
+    losses = []
+    for _ in range(2):
+        state, stats = engine.run_epoch(state, xs_d, ys_d)
+        losses.append(np.asarray(stats["loss"]))
+    return engine.gather_center(state), np.concatenate(losses)
+
+
 def test_staged_lm_pipeline_matches_sequential_dp():
     """GPipe-for-LM: 2 workers x 4 stages == 2 workers sequential on the
     staged causal LM — per-token outputs stream through the pipeline's
@@ -117,23 +127,14 @@ def test_staged_lm_pipeline_matches_sequential_dp():
     adapter = StagedLM(vocab_size=23, dim=32, heads=2, num_stages=4,
                        blocks_per_stage=1, max_len=64)
 
-    def run(engine):
-        xs_d, ys_d = engine.shard_batches(xs, ys)
-        state = engine.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
-        losses = []
-        for _ in range(2):
-            state, stats = engine.run_epoch(state, xs_d, ys_d)
-            losses.append(np.asarray(stats["loss"]))
-        return engine.gather_center(state), np.concatenate(losses)
-
     pp = PipelineEngine(adapter, "token_crossentropy",
                         ("sgd", {"learning_rate": 0.05}), Downpour(2),
                         num_workers=2, metrics=("token_accuracy",))
     dp = WindowedEngine(adapter, "token_crossentropy",
                         ("sgd", {"learning_rate": 0.05}), Downpour(2),
                         num_workers=2, metrics=("token_accuracy",))
-    center_pp, loss_pp = run(pp)
-    center_dp, loss_dp = run(dp)
+    center_pp, loss_pp = _run_two_epochs(pp, xs, ys)
+    center_dp, loss_dp = _run_two_epochs(dp, xs, ys)
     np.testing.assert_allclose(loss_pp, loss_dp, rtol=2e-4, atol=2e-5)
     for a, b in zip(jax.tree.leaves(center_pp), jax.tree.leaves(center_dp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -214,23 +215,14 @@ def test_lm_tp_matches_dp_trajectory():
     x, y = lm_data(n=128)
     xs, ys = epoch_data(x, y, num_workers=2, n_windows=2, window=2, batch=8)
 
-    def run(engine):
-        xs_d, ys_d = engine.shard_batches(xs, ys)
-        state = engine.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
-        losses = []
-        for _ in range(2):
-            state, stats = engine.run_epoch(state, xs_d, ys_d)
-            losses.append(np.asarray(stats["loss"]))
-        return engine.gather_center(state), np.concatenate(losses)
-
     dp = WindowedEngine(_lm(), "token_crossentropy",
                         ("sgd", {"learning_rate": 0.05}), Downpour(2),
                         num_workers=2, metrics=())
     tp = GSPMDEngine(_lm(), "token_crossentropy",
                      ("sgd", {"learning_rate": 0.05}), Downpour(2),
                      num_workers=2, tp_shards=4, metrics=())
-    p_dp, loss_dp = run(dp)
-    p_tp, loss_tp = run(tp)
+    p_dp, loss_dp = _run_two_epochs(dp, xs, ys)
+    p_tp, loss_tp = _run_two_epochs(tp, xs, ys)
     np.testing.assert_allclose(loss_tp, loss_dp, rtol=2e-4, atol=2e-5)
     for a, b in zip(jax.tree.leaves(p_dp), jax.tree.leaves(p_tp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
