@@ -50,6 +50,9 @@ def main():
     parser.add_argument("--dispatch-epochs", type=int, default=1,
                         help="epochs per device dispatch (>1: one jitted "
                              "multi-epoch program with on-device reshuffle)")
+    parser.add_argument("--digits", action="store_true",
+                        help="pin the sklearn digits fallback regardless of "
+                             "any cached MNIST (machine-independent runs)")
     args = parser.parse_args()
 
     import jax
@@ -58,7 +61,7 @@ def main():
     from distkeras_tpu.models import MLP, FlaxModel
 
     num_workers = args.workers or jax.device_count()
-    _, x, y, max_val, img_shape = load_dataset()
+    _, x, y, max_val, img_shape = load_dataset(force_digits=args.digits)
     num_features = x.shape[1]
     print(f"dataset: {len(x)} samples, {num_features} features, "
           f"{num_workers} workers on {jax.default_backend()}")
