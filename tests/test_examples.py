@@ -57,3 +57,16 @@ def test_lm_example_learns_and_generates():
     assert len(accs) == 3 and all(a > 0.9 for a in accs), out
     gen = re.search(r"greedy generation: \[([0-9 ]+)\]", out)
     assert gen is not None, out
+
+
+@pytest.mark.slow
+def test_workflow_example_tours_every_trainer():
+    out = _run_example("workflow.py", [])
+    assert "workflow complete" in out, out
+    rows = dict(re.findall(r"^(\w+)\s+acc=([0-9.]+)", out, re.M))
+    assert len(rows) == 7, out
+    accs = {k: float(v) for k, v in rows.items()}
+    assert accs["SingleTrainer"] > 0.85, accs
+    # loose sanity floor: nothing collapses to chance (3 classes ~ 0.33)
+    for name, a in accs.items():
+        assert a > 0.6, accs
