@@ -70,3 +70,12 @@ def test_workflow_example_tours_every_trainer():
     # loose sanity floor: nothing collapses to chance (3 classes ~ 0.33)
     for name, a in accs.items():
         assert a > 0.6, accs
+
+
+@pytest.mark.slow
+def test_parallelism_example_tours_all_axes():
+    out = _run_example("parallelism.py", [])
+    rows = dict(re.findall(r"^(.+?)\s{2,}acc=([0-9.]+)", out, re.M))
+    assert len(rows) == 6, out
+    for name, acc in rows.items():
+        assert float(acc) > 0.6, (name, rows)
