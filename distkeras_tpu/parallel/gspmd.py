@@ -28,6 +28,14 @@ so the shard_map engine remains the default for pure data parallelism.
 masked-commit body as the shard_map engine).  Not supported: ``seq_shards``
 ring attention, which is a hand-placed-collective design by nature — use
 ``WindowedEngine`` for sequence parallelism.
+
+``fsdp=True`` additionally shards the *center variable* over the workers
+axis (ZeRO-3 / gather-at-use: all-gather at the window-boundary pull,
+reduce-scatter after the commit psum, both placed by the partitioner) —
+the replicated parameter-server copy stops costing ``num_devices x`` HBM.
+Composes with ``tp_shards`` (a leaf can shard over both axes) and is a pure
+layout change: trajectories equal the data-parallel run within float
+tolerance (reduction order may shift under partitioning — tests/test_fsdp.py).
 """
 
 from __future__ import annotations
@@ -69,6 +77,7 @@ class GSPMDEngine(WindowedEngine):
         num_workers: Optional[int] = None,
         *,
         tp_shards: int = 1,
+        fsdp: bool = False,
         spec_fn=None,
         metrics: Sequence = ("accuracy",),
         compute_dtype: Optional[Any] = None,
@@ -80,6 +89,16 @@ class GSPMDEngine(WindowedEngine):
     ):
         devices = list(devices if devices is not None else jax.devices())
         self.tp_shards = int(tp_shards)
+        # ZeRO-3-style center sharding: store the center variable sharded
+        # over the *workers* axis instead of replicated (center-rule state is
+        # NOT constrained — every shipped rule keeps only a scalar counter
+        # there).  The partitioner materialises it with an
+        # all-gather at the window-boundary pull and a reduce-scatter after
+        # the commit psum — gather-at-use, the idiomatic TPU form of FSDP.
+        # Per-worker local state is untouched (each worker's copy is distinct
+        # by construction in this algorithm family — there is no redundancy
+        # over the workers axis to eliminate there).
+        self.fsdp = bool(fsdp)
         # Optional placement override: shape -> PartitionSpec, or None to
         # fall through to the default Megatron-style rule.  This is how
         # expert parallelism rides this engine (models/moe.expert_partition
@@ -141,7 +160,15 @@ class GSPMDEngine(WindowedEngine):
                             f"(leaf shape {tuple(shape)}, path {path})"
                         )
                 return spec
-        if len(shape) >= 2 and shape[-1] % self.tp_shards == 0 and shape[-1] >= 2 * self.tp_shards:
+        # tp_shards == 1: a size-1 model axis is a layout no-op, but naming it
+        # would block _center_spec from giving that dim to the workers axis
+        # under fsdp — leave every dim free instead.
+        if (
+            self.tp_shards > 1
+            and len(shape) >= 2
+            and shape[-1] % self.tp_shards == 0
+            and shape[-1] >= 2 * self.tp_shards
+        ):
             return P(*([None] * (len(shape) - 1)), TP_AXIS)
         return P()
 
@@ -152,10 +179,27 @@ class GSPMDEngine(WindowedEngine):
             for k in path
         )
 
+    def _center_spec(self, shape, path=()) -> P:
+        """TP placement plus, under ``fsdp=True``, the workers axis on the
+        largest still-free evenly-splitting dim — each device then stores
+        ``1/n_dev`` of the center variable.  Leaves with no such dim stay
+        replicated (correct either way; sharding is a layout choice)."""
+        spec = list(self._tp_spec(shape, path))
+        spec += [None] * (len(shape) - len(spec))
+        if self.fsdp and self.n_dev > 1:
+            free = [
+                d for d, name in enumerate(spec)
+                if name is None and shape[d] % self.n_dev == 0
+                and shape[d] >= 2 * self.n_dev
+            ]
+            if free:
+                spec[max(free, key=lambda d: shape[d])] = WORKER_AXIS
+        return P(*spec)
+
     def _constrain_center(self, tree):
         return jax.tree_util.tree_map_with_path(
             lambda path, x: lax.with_sharding_constraint(
-                x, NamedSharding(self.mesh, self._tp_spec(x.shape, self._key_names(path)))
+                x, NamedSharding(self.mesh, self._center_spec(x.shape, self._key_names(path)))
             ),
             tree,
         )

@@ -75,6 +75,7 @@ class Trainer:
         profile_dir: Optional[str] = None,
         seq_shards: int = 1,
         tp_shards: int = 1,
+        fsdp: bool = False,
         tensorboard_dir: Optional[str] = None,
         streaming: bool = False,
         remat: bool = False,
@@ -140,6 +141,10 @@ class Trainer:
         # tensor parallelism shards: >1 selects the GSPMD engine (param
         # leaves sharded over a 'model' mesh axis; any model, unmodified)
         self.tp_shards = int(tp_shards)
+        # ZeRO-3-style sharding of the center variable over the workers axis
+        # (GSPMD engine; composes with tp_shards) — pure layout change, the
+        # replicated parameter-server copy stops costing num_devices x HBM
+        self.fsdp = bool(fsdp)
         # pipeline parallelism stages: >1 selects the pipeline engine
         # (microbatch ppermute pipeline over a 'stages' mesh axis; requires a
         # staged adapter, models/staged.StagedTransformer, with num_stages ==
@@ -213,10 +218,10 @@ class Trainer:
         adapter = as_adapter(self.master_model)
         feats, labels = self._load_columns(dataframe)
         if self.pipeline_stages > 1:
-            if self.tp_shards > 1 or self.seq_shards > 1:
+            if self.tp_shards > 1 or self.seq_shards > 1 or self.fsdp:
                 raise ValueError(
                     "pipeline_stages>1 composes with data parallelism only "
-                    "(not tp_shards/seq_shards in this release)"
+                    "(not tp_shards/seq_shards/fsdp in this release)"
                 )
             if commit_schedule is not None:
                 raise ValueError(
@@ -244,10 +249,10 @@ class Trainer:
                 remat=self.remat,
                 unroll=self.unroll,
             )
-        elif self.tp_shards > 1:
+        elif self.tp_shards > 1 or self.fsdp:
             if self.seq_shards > 1:
                 raise ValueError(
-                    "tp_shards>1 (GSPMD engine) is incompatible with "
+                    "tp_shards>1/fsdp (GSPMD engine) is incompatible with "
                     "seq_shards>1 (ring attention needs the shard_map engine)"
                 )
             from distkeras_tpu.parallel.gspmd import GSPMDEngine
@@ -259,6 +264,7 @@ class Trainer:
                 rule,
                 num_workers,
                 tp_shards=self.tp_shards,
+                fsdp=self.fsdp,
                 spec_fn=self.tp_spec_fn,
                 metrics=self.metrics,
                 compute_dtype=self.compute_dtype,
@@ -605,6 +611,7 @@ class DistributedTrainer(Trainer):
         profile_dir: Optional[str] = None,
         seq_shards: int = 1,
         tp_shards: int = 1,
+        fsdp: bool = False,
         tensorboard_dir: Optional[str] = None,
         streaming: bool = False,
         remat: bool = False,
@@ -618,7 +625,7 @@ class DistributedTrainer(Trainer):
             keras_model, loss, worker_optimizer, metrics,
             features_col, label_col, batch_size, num_epoch, seed, compute_dtype,
             checkpoint_dir, checkpoint_every, resume, profile_dir, seq_shards,
-            tp_shards, tensorboard_dir, streaming, remat, unroll,
+            tp_shards, fsdp, tensorboard_dir, streaming, remat, unroll,
             dispatch_epochs, pipeline_stages, pp_microbatches, tp_spec_fn,
         )
         self.num_workers = num_workers or jax.device_count()

@@ -1,7 +1,7 @@
 """Tour of the parallelism axes beyond plain data parallelism.
 
 The reference's only axis was Spark-task data parallelism; this example runs
-the rebuild's six extra axes on a faked 8-device CPU mesh so it works on
+the rebuild's seven extra axes on a faked 8-device CPU mesh so it works on
 any machine (swap to real chips by deleting the two config lines):
 
   1. virtual workers      — more logical workers than devices (the analogue
@@ -14,6 +14,8 @@ any machine (swap to real chips by deleting the two config lines):
                             (workers x stages) mesh (staged transformer)
   6. expert parallelism   — Switch MoE with the expert stacks sharded over
                             the model axis (GSPMD placement override)
+  7. FSDP / ZeRO-3        — the center variable sharded over the workers
+                            axis (gather-at-use) instead of replicated
 """
 
 import os
@@ -103,6 +105,15 @@ def main():
                     communication_window=2, tp_shards=2,
                     tp_spec_fn=expert_partition(4))
     report("Switch MoE 4w x 2experts", t, t.train(tdf), tokens, ty)
+
+    # 7. FSDP / ZeRO-3: the center variable itself sharded over the workers
+    #    axis (all-gather at pull, reduce-scatter at commit) — same
+    #    trajectory as plain DP, 1/num_devices the center HBM
+    t = dk.DOWNPOUR(FlaxModel(MLP(features=(64,), num_classes=4)),
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=8, batch_size=16, num_epoch=5,
+                    communication_window=4, fsdp=True)
+    report("FSDP-sharded center 8w", t, t.train(df))
 
 
 if __name__ == "__main__":

@@ -76,6 +76,6 @@ def test_workflow_example_tours_every_trainer():
 def test_parallelism_example_tours_all_axes():
     out = _run_example("parallelism.py", [])
     rows = dict(re.findall(r"^(.+?)\s{2,}acc=([0-9.]+)", out, re.M))
-    assert len(rows) == 6, out
+    assert len(rows) == 7, out
     for name, acc in rows.items():
         assert float(acc) > 0.6, (name, rows)
