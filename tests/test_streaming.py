@@ -77,6 +77,39 @@ def test_streaming_trajectory_bit_identical(toy_classification):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_streaming_bf16_transfer_bit_identical(toy_classification):
+    """Under ``compute_dtype=bf16`` the streaming path pre-casts float
+    features on host (halving the bytes over the link) — value-identical to
+    the in-memory path's on-device cast, so the trajectory stays bit-exact."""
+    import jax.numpy as jnp
+
+    x, y, onehot = toy_classification
+    workers, batch, window = 4, 16, 4
+
+    def engine():
+        return WindowedEngine(
+            FlaxModel(MLP(features=(16,), num_classes=2)),
+            loss="categorical_crossentropy",
+            worker_optimizer=("sgd", {"learning_rate": 0.05}),
+            rule=Downpour(communication_window=4),
+            num_workers=workers, compute_dtype=jnp.bfloat16,
+        )
+
+    eng_a, eng_b = engine(), engine()
+    state_a = eng_a.init_state(jax.random.PRNGKey(0), x[:batch])
+    state_b = eng_b.init_state(jax.random.PRNGKey(0), x[:batch])
+    rng_a, rng_b = np.random.default_rng(3), np.random.default_rng(3)
+    for _ in range(2):
+        xs, ys = epoch_arrays(x, onehot, workers, batch, window, rng=rng_a)
+        xs, ys = eng_a.shard_batches(xs, ys)
+        state_a, _ = eng_a.run_epoch(state_a, xs, ys)
+        blocks = epoch_window_iter(x, onehot, workers, batch, window, rng=rng_b)
+        state_b, _ = eng_b.run_epoch_streaming(state_b, blocks)
+    for a, b in zip(jax.tree.leaves(state_a.center_params),
+                    jax.tree.leaves(state_b.center_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_trainer_streaming_kwarg_matches_in_memory(toy_classification):
     x, y, onehot = toy_classification
 
