@@ -1,12 +1,14 @@
 """Causal language modeling across the parallelism axes.
 
 Beyond the reference's classifier-only scope: trains a small causal
-transformer LM on a synthetic next-token corpus three ways —
+transformer LM on a synthetic next-token corpus four ways —
 
   1. data parallel            (TransformerLM, 4 workers)
   2. + sequence parallelism   (causal ring attention, per-token labels
                                sharded over the seq axis with the tokens)
   3. pipeline parallel        (StagedLM: GPipe-for-LM, 4 workers x 2 stages)
+  4. tp + FSDP center         (GSPMD engine: embedding/head center copies
+                               sharded over workers AND model axes)
 
 — then greedily generates from the trained model.  Runs on a faked
 8-device CPU mesh so it works anywhere (delete the two config lines on
@@ -88,6 +90,15 @@ def main():
                  blocks_per_stage=1, max_len=64),
         worker_optimizer=("adam", {"learning_rate": 1e-3}),
         num_workers=4, pipeline_stages=2, **common))
+
+    # FSDP: the LM's embedding + output head dominate its params — with
+    # fsdp=True their center copies shard over the workers axis instead of
+    # replicating (ZeRO-3 gather-at-use), here composed with 2-way TP
+    report("LM + fsdp center (4w x 2mp)", dk.DOWNPOUR(
+        FlaxModel(TransformerLM(vocab_size=VOCAB, dim=32, heads=2,
+                                num_layers=1, max_len=64)),
+        worker_optimizer=("adam", {"learning_rate": 1e-3}),
+        num_workers=4, tp_shards=2, fsdp=True, **common))
 
     ctx = generate(trained, x[:1, :8])
     print("greedy generation:", ctx[0, 8:], "from context ending at", ctx[0, 7])
