@@ -21,7 +21,10 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step", "CheckpointManager"]
+__all__ = [
+    "save_checkpoint", "restore_checkpoint", "restore_center", "latest_step",
+    "checkpoint_num_workers", "CheckpointManager",
+]
 
 _CHECKPOINTER = None
 
@@ -74,12 +77,7 @@ def restore_checkpoint(directory: str, step: Optional[int] = None, like: Any = N
     TrainState) restores exact structure/dtypes and device placement."""
     import orbax.checkpoint as ocp
 
-    wait_until_finished()
-    if step is None:
-        step = latest_step(directory)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
-    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    path = _step_path(directory, step)
     template = jax.tree.map(np.asarray, like) if like is not None else None
     restored = _checkpointer().restore(
         path, args=ocp.args.StandardRestore(template)
@@ -94,6 +92,58 @@ def restore_checkpoint(directory: str, step: Optional[int] = None, like: Any = N
             restored,
         )
     return restored
+
+
+def _step_path(directory: str, step: Optional[int]) -> str:
+    wait_until_finished()
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    return os.path.join(os.path.abspath(directory), f"step_{step}")
+
+
+def _metadata_tree(path: str):
+    meta = _checkpointer().metadata(path)
+    tree = getattr(meta, "item_metadata", meta)
+    return getattr(tree, "tree", tree)
+
+
+def restore_center(directory: str, step: Optional[int] = None) -> dict:
+    """Partial restore for elastic resume: only the center variable, its
+    rule state, the model state, and the epoch counter leave disk; the
+    per-worker subtrees (local replicas, optimizer state, rule locals,
+    rngs) — ~3N x the model size at N workers — restore as Orbax
+    placeholders, i.e. are never read."""
+    import orbax.checkpoint as ocp
+
+    path = _step_path(directory, step)
+    tree = _metadata_tree(path)
+    keep = ("center_params", "center_rule", "model_state", "epoch")
+
+    def template_for(key, sub):
+        if key in keep:
+            return jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype), sub
+            )
+        return jax.tree.map(lambda m: ocp.PLACEHOLDER, sub)
+
+    template = {k: template_for(k, v) for k, v in tree.items()}
+    # PLACEHOLDER is a PyTree-handler feature (the Standard handler rejects
+    # it); both handlers share the on-disk format, so reading a
+    # StandardSave checkpoint through PyTreeRestore is exact.
+    restored = ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).restore(
+        path, args=ocp.args.PyTreeRestore(item=template)
+    )
+    return {k: restored[k] for k in keep}
+
+
+def checkpoint_num_workers(directory: str, step: Optional[int] = None) -> int:
+    """Worker count a checkpoint was written at: the leading dim of its
+    per-worker ``rng`` leaf, read from array METADATA only (no tensor data
+    leaves disk) — the cheap probe behind elastic resume."""
+    tree = _metadata_tree(_step_path(directory, step))
+    return int(tree["rng"].shape[0])
 
 
 class CheckpointManager:
@@ -139,6 +189,12 @@ class CheckpointManager:
 
     def latest(self) -> Optional[int]:
         return latest_step(self.directory)
+
+    def saved_worker_count(self, step: Optional[int] = None) -> int:
+        return checkpoint_num_workers(self.directory, step)
+
+    def restore_center(self, step: Optional[int] = None) -> dict:
+        return restore_center(self.directory, step)
 
     def restore(self, like: Any = None, step: Optional[int] = None) -> Any:
         return restore_checkpoint(self.directory, step, like)

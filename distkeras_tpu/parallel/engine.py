@@ -187,28 +187,71 @@ class WindowedEngine:
         with self.mesh:
             return jax.jit(_build, out_shardings=shardings)(params, model_state)
 
+    def _constrain_center(self, tree):
+        """Placement hook for center leaves inside state assembly — identity
+        here (center is replicated by the shard_map specs); the GSPMD engine
+        overrides it with TP/fsdp sharding constraints."""
+        return tree
+
+    def _constrain_worker(self, tree):
+        """Placement hook for per-worker ``[num_workers, ...]`` leaves —
+        identity here; GSPMD adds workers-axis + TP constraints."""
+        return tree
+
     def _assemble_state(self, rng, params, model_state) -> TrainState:
         """Pure state assembly (jittable): tile per-worker leaves, init the
-        optimizer and rule states."""
+        optimizer and rule states.  The single recipe for every engine —
+        subclasses redirect placement via the ``_constrain_*`` hooks."""
         n = self.num_workers
+        params = self._constrain_center(params)
         center_rule = self.rule.init_center_state()
         rule_local = self.rule.init_local_state(params)
         tile = lambda t: jax.tree.map(
             lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
         )
-        local_params = tile(params)
-        opt_state = jax.vmap(self.optimizer.init)(local_params)
+        local_params = self._constrain_worker(tile(params))
+        opt_state = self._constrain_worker(jax.vmap(self.optimizer.init)(local_params))
         rngs = jax.random.split(jax.random.fold_in(rng, 1), n)
         return TrainState(
             center_params=params,
             center_rule=center_rule,
             local_params=local_params,
             opt_state=opt_state,
-            model_state=tile(model_state),
-            rule_local=tile(rule_local),
+            model_state=self._constrain_worker(tile(model_state)),
+            rule_local=self._constrain_worker(tile(rule_local)),
             rng=rngs,
             epoch=jnp.zeros((), jnp.int32),
         )
+
+    def state_from_center(
+        self, rng: jax.Array, center_params, center_rule, model_state, epoch
+    ) -> TrainState:
+        """Elastic resume: rebuild full training state around a restored
+        center variable at THIS engine's worker count (which may differ from
+        the count the checkpoint was written at).
+
+        Local replicas adopt the center — the semantics of the reference's
+        worker retry, which reconnects to the PS and pulls
+        (``distkeras/workers.py``; SURVEY.md §5.3 "a retried worker
+        reconnects and keeps training") — optimizer and rule local state
+        re-initialise, and the center-side rule state (commit counters) and
+        epoch survive.  Exact same-count resume should use the bitwise
+        checkpoint restore instead (``CheckpointManager.restore(like=...)``).
+        """
+        # host trees go straight into the jitted build: jit places the args
+        # under their constrained shardings in one transfer (an eager
+        # asarray here would first materialise the full center replicated
+        # on one device — the spike fsdp exists to avoid)
+        def _build(params, ms):
+            st = self._assemble_state(rng, params, ms)
+            return st.replace(
+                center_rule=center_rule,
+                epoch=jnp.asarray(epoch, jnp.int32),
+            )
+
+        shardings = self._state_shardings(_build, center_params, model_state)
+        with self.mesh:
+            return jax.jit(_build, out_shardings=shardings)(center_params, model_state)
 
     def _state_shardings(self, build_fn, params, model_state):
         """out_shardings for the initial state: center leaves replicated,

@@ -218,35 +218,14 @@ class GSPMDEngine(WindowedEngine):
         return jax.tree_util.tree_map_with_path(one, tree)
 
     # ------------------------------------------------------------------ init
-    def init_state(self, rng: jax.Array, sample_input) -> TrainState:
-        params, model_state = self.adapter.init(rng, sample_input)
-        n = self.num_workers
+    # state assembly is the base class recipe; this engine only redirects
+    # the _constrain_center/_constrain_worker placement hooks (below)
 
-        def _build(params, model_state):
-            params = self._constrain_center(params)
-            center_rule = self.rule.init_center_state()
-            tile = lambda t: jax.tree.map(
-                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t
-            )
-            local_params = self._constrain_worker(tile(params))
-            opt_state = self._constrain_worker(
-                jax.vmap(self.optimizer.init)(local_params)
-            )
-            rule_local = self._constrain_worker(tile(self.rule.init_local_state(params)))
-            rngs = jax.random.split(jax.random.fold_in(rng, 1), n)
-            return TrainState(
-                center_params=params,
-                center_rule=center_rule,
-                local_params=local_params,
-                opt_state=opt_state,
-                model_state=self._constrain_worker(tile(model_state)),
-                rule_local=rule_local,
-                rng=rngs,
-                epoch=jnp.zeros((), jnp.int32),
-            )
-
-        with self.mesh:
-            return jax.jit(_build)(params, model_state)
+    def _state_shardings(self, build_fn, params, model_state):
+        # placement comes from the with_sharding_constraint calls inside
+        # _assemble_state; let jit infer the outputs from those
+        del build_fn, params, model_state
+        return None
 
     # ------------------------------------------------------------------ epoch
     def _build_epoch_core(self, n_windows: int, window: int, do_commit: bool, xs_ndim: int = 5):

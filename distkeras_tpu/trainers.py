@@ -205,6 +205,30 @@ class Trainer:
             labels = labels_raw.astype(np.float32)
         return feats, labels
 
+    def _restore_state(self, ckpt, engine, state, elastic: bool):
+        """Resume from ``checkpoint_dir``: bitwise when the checkpoint was
+        written at this trainer's worker count; **elastic** otherwise — the
+        restored center variable (and its commit counters and epoch) carry
+        over, and the new worker set re-pulls it as fresh local replicas,
+        which is the reference's worker-retry semantics (a retried Spark
+        task reconnects to the PS and pulls — SURVEY.md §5.3).  Beyond
+        reference: upstream had no way to continue a run on a different
+        cluster size at all."""
+        if not elastic:
+            return ckpt.restore(like=state)  # bitwise path, single read
+        raw = ckpt.restore_center()  # elastic: only center/rule/epoch read
+        epoch = int(np.asarray(raw["epoch"]))
+        # per-worker model state (BatchNorm stats) collapses to its mean —
+        # the same semantic sync_model_state applies at every commit
+        model_state = jax.tree.map(
+            lambda x: np.asarray(x).mean(axis=0).astype(np.asarray(x).dtype),
+            raw["model_state"],
+        )
+        return engine.state_from_center(
+            jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch),
+            raw["center_params"], raw["center_rule"], model_state, epoch,
+        )
+
     def _fit(
         self,
         dataframe: DataFrame,
@@ -288,17 +312,45 @@ class Trainer:
             )
         window = rule.communication_window if rule.communication_window > 0 else None
         rng = np.random.default_rng(self.seed)
-        state = engine.init_state(jax.random.PRNGKey(self.seed), feats[: self.batch_size])
 
         ckpt = None
         start_epoch = 0
+        resuming = False
+        elastic = False
         if self.checkpoint_dir:
             from distkeras_tpu.checkpoint import CheckpointManager
 
             ckpt = CheckpointManager(self.checkpoint_dir, every=self.checkpoint_every)
-            if self.resume and ckpt.latest() is not None:
-                state = ckpt.restore(like=state)
-                start_epoch = int(np.asarray(state.epoch))
+            resuming = self.resume and ckpt.latest() is not None
+            elastic = resuming and ckpt.saved_worker_count() != engine.num_workers
+            if elastic and rule.communication_window <= 0:
+                # no-commit rules (Sequential/OneShotAverage) never fold
+                # progress into the center mid-training, so an elastic
+                # resume would silently restart from initialization with a
+                # nonzero epoch counter — refuse loudly instead
+                raise ValueError(
+                    f"elastic resume (checkpoint at "
+                    f"{ckpt.saved_worker_count()} workers, trainer at "
+                    f"{engine.num_workers}) requires a committing rule; "
+                    f"{type(rule).__name__} only produces its result at the "
+                    "end of training, so the checkpointed center carries no "
+                    "progress to adopt.  Resume with the original "
+                    "num_workers instead."
+                )
+
+        # The elastic path builds its state straight from the partial
+        # restore — a fresh init_state would be thrown away (and costs a
+        # full-state materialisation).  The pipeline engine still needs
+        # init_state first (it probes the staged shapes there), and the
+        # bitwise path needs it as the restore template.
+        state = None
+        if not elastic or self.pipeline_stages > 1:
+            state = engine.init_state(
+                jax.random.PRNGKey(self.seed), feats[: self.batch_size]
+            )
+        if resuming:
+            state = self._restore_state(ckpt, engine, state, elastic)
+            start_epoch = int(np.asarray(state.epoch))
 
         # keep the host RNG stream aligned with the epoch counter on resume
         # (chunked dispatch shuffles on device, keyed by state.epoch — its

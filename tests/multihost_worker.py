@@ -7,7 +7,8 @@ global 8-device mesh — commits ride the cross-process collective path (the
 DCN analogue).  ``engine=windowed`` runs the shard_map engine over a 1-D
 workers mesh; ``engine=gspmd`` runs the pjit engine over a 2-D
 (workers, model) mesh, so tensor-parallel sharding propagation is exercised
-across process boundaries too.
+across process boundaries too; ``engine=fsdp`` stores the center variable
+ZeRO-3-sharded over a workers axis spanning both processes.
 """
 
 import sys
@@ -76,6 +77,22 @@ def main(coordinator: str, num_processes: int, process_id: int,
             num_workers=num_workers,
             tp_shards=2,
         )
+    elif engine_kind == "fsdp":
+        # ZeRO-3 center sharding over a workers axis that SPANS the process
+        # boundary: each process stores only its slice of the center
+        # variable, and the partitioner's gather-at-pull / scatter-at-commit
+        # ride the cross-process (DCN-analogue) wire.
+        from distkeras_tpu.parallel.gspmd import GSPMDEngine
+
+        num_workers = 8
+        engine = GSPMDEngine(
+            FlaxModel(MLP(features=(16,), num_classes=2)),
+            "categorical_crossentropy",
+            ("sgd", {"learning_rate": 0.1}),
+            Downpour(communication_window=2),
+            num_workers=num_workers,
+            fsdp=True,
+        )
     else:
         from distkeras_tpu.parallel.engine import WindowedEngine
 
@@ -105,6 +122,15 @@ def main(coordinator: str, num_processes: int, process_id: int,
     ys = onehot.reshape(num_workers, 2, 2, batch, 2)
 
     state = engine.init_state(jax.random.PRNGKey(0), x[:16])
+    if engine_kind == "fsdp":
+        # the sharded center must actually span processes: some leaf's
+        # shards live on devices owned by different process indices
+        spans = any(
+            len({d.process_index for d in leaf.sharding.device_set}) > 1
+            and not leaf.sharding.is_fully_replicated
+            for leaf in jax.tree.leaves(state.center_params)
+        )
+        assert spans, "no center leaf is sharded across processes"
     xs_d, ys_d = engine.shard_batches(xs, ys)
     losses = []
     for _ in range(6):

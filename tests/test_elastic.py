@@ -1,0 +1,181 @@
+"""Elastic resume: a checkpoint written at N workers resumes at M.
+
+The reference cannot do this at all — its recovery story is Spark retrying
+individual tasks against the driver's in-memory PS (SURVEY.md §5.3); a
+different cluster size means starting over.  Here the center variable (and
+its commit counters and epoch) carries over and the new worker set re-pulls
+it, exactly the reference's worker-retry semantics scaled to a resize."""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+
+import distkeras_tpu as dk
+from distkeras_tpu import checkpoint as ck
+from distkeras_tpu.algorithms import Downpour
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.models import MLP, FlaxModel
+from distkeras_tpu.parallel import GSPMDEngine, WindowedEngine
+
+
+def _engine(num_workers, cls=WindowedEngine, **kw):
+    return cls(FlaxModel(MLP(features=(16,), num_classes=2)),
+               "categorical_crossentropy", ("sgd", {"learning_rate": 0.05}),
+               Downpour(communication_window=4), num_workers=num_workers,
+               metrics=(), **kw)
+
+
+def _epoch(x, onehot, workers, n_windows=2, window=4, batch=8):
+    n = workers * n_windows * window * batch
+    xs = x[:n].reshape(workers, n_windows, window, batch, -1)
+    ys = np.argmax(onehot[:n], -1).reshape(workers, n_windows, window, batch)
+    return xs, ys.astype(np.int32)
+
+
+def test_state_from_center_adopts_center_and_counters(toy_classification):
+    """8-worker training state -> 4-worker state: center, commit counter and
+    epoch survive; every new local replica equals the center (fresh pull)."""
+    x, y, onehot = toy_classification
+    a = _engine(8)
+    state = a.init_state(jax.random.PRNGKey(0), x[:8])
+    xs, ys = _epoch(x, onehot, 8)
+    sxs, sys_ = a.shard_batches(xs, ys)
+    state, _ = a.run_epoch(state, sxs, sys_)
+
+    b = _engine(4)
+    resumed = b.state_from_center(
+        jax.random.PRNGKey(1),
+        jax.tree.map(np.asarray, state.center_params),
+        jax.tree.map(np.asarray, state.center_rule),
+        jax.tree.map(lambda v: np.asarray(v).mean(0), state.model_state),
+        np.asarray(state.epoch),
+    )
+    assert int(np.asarray(resumed.epoch)) == 1
+    assert int(np.asarray(resumed.center_rule["num_updates"])) == int(
+        np.asarray(state.center_rule["num_updates"])
+    )
+    for src, dst in zip(jax.tree.leaves(state.center_params),
+                        jax.tree.leaves(resumed.center_params)):
+        np.testing.assert_array_equal(np.asarray(src), np.asarray(dst))
+    # locals re-pulled the center
+    for c, loc in zip(jax.tree.leaves(resumed.center_params),
+                      jax.tree.leaves(resumed.local_params)):
+        loc = np.asarray(loc)
+        assert loc.shape[0] == 4
+        for w in range(4):
+            np.testing.assert_array_equal(loc[w], np.asarray(c))
+    # and the resized engine trains on
+    xs4, ys4 = _epoch(x, onehot, 4)
+    sxs4, sys4 = b.shard_batches(xs4, ys4)
+    resumed, stats = b.run_epoch(resumed, sxs4, sys4)
+    assert np.isfinite(np.asarray(stats["loss"])).all()
+
+
+def test_trainer_elastic_resume_across_worker_counts(toy_classification):
+    """Full trainer flow: checkpoint at 8 workers, resume=True at 4."""
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    with tempfile.TemporaryDirectory() as d:
+        t8 = dk.DOWNPOUR(FlaxModel(MLP(features=(16,), num_classes=2)),
+                         loss="categorical_crossentropy",
+                         worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                         num_workers=8, batch_size=16, num_epoch=2,
+                         communication_window=4, seed=3, checkpoint_dir=d)
+        t8.train(df)
+        assert ck.latest_step(d) == 2
+
+        t4 = dk.DOWNPOUR(FlaxModel(MLP(features=(16,), num_classes=2)),
+                         loss="categorical_crossentropy",
+                         worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                         num_workers=4, batch_size=16, num_epoch=6,
+                         communication_window=4, seed=3, checkpoint_dir=d,
+                         resume=True)
+        trained = t4.train(df)
+        # resumed at epoch 2, ran 4 more; history covers only the new epochs
+        assert len(t4.get_history()["loss"]) == 4
+        preds = np.argmax(trained.predict(x), -1)
+        assert np.mean(preds == np.argmax(onehot, -1)) > 0.8
+
+
+def test_elastic_resume_into_fsdp_engine(toy_classification):
+    """The resized engine can be a different KIND too: a shard_map-trained
+    checkpoint resumes into a GSPMD engine with a ZeRO-sharded center."""
+    x, y, onehot = toy_classification
+    a = _engine(8)
+    state = a.init_state(jax.random.PRNGKey(0), x[:8])
+    xs, ys = _epoch(x, onehot, 8)
+    sxs, sys_ = a.shard_batches(xs, ys)
+    state, _ = a.run_epoch(state, sxs, sys_)
+
+    b = _engine(4, cls=GSPMDEngine, fsdp=True)
+    resumed = b.state_from_center(
+        jax.random.PRNGKey(1),
+        jax.tree.map(np.asarray, state.center_params),
+        jax.tree.map(np.asarray, state.center_rule),
+        jax.tree.map(lambda v: np.asarray(v).mean(0), state.model_state),
+        np.asarray(state.epoch),
+    )
+    for src, dst in zip(jax.tree.leaves(state.center_params),
+                        jax.tree.leaves(resumed.center_params)):
+        np.testing.assert_array_equal(np.asarray(src), np.asarray(dst))
+    xs4, ys4 = _epoch(x, onehot, 4)
+    sxs4, sys4 = b.shard_batches(xs4, ys4)
+    resumed, stats = b.run_epoch(resumed, sxs4, sys4)
+    assert np.isfinite(np.asarray(stats["loss"])).all()
+
+
+def test_elastic_refuses_non_committing_rules(toy_classification):
+    """AveragingTrainer never commits mid-training (its result is the final
+    one-shot average), so its checkpointed center carries no progress — an
+    elastic resume must refuse rather than silently restart from init."""
+    import pytest
+
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    with tempfile.TemporaryDirectory() as d:
+        t8 = dk.AveragingTrainer(FlaxModel(MLP(features=(16,), num_classes=2)),
+                                 loss="categorical_crossentropy",
+                                 worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                                 num_workers=8, batch_size=16, num_epoch=2,
+                                 seed=3, checkpoint_dir=d)
+        t8.train(df)
+        t4 = dk.AveragingTrainer(FlaxModel(MLP(features=(16,), num_classes=2)),
+                                 loss="categorical_crossentropy",
+                                 worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                                 num_workers=4, batch_size=16, num_epoch=2,
+                                 seed=3, checkpoint_dir=d, resume=True)
+        with pytest.raises(ValueError, match="elastic resume"):
+            t4.train(df)
+
+
+def test_same_count_resume_stays_bitwise(toy_classification):
+    """The elastic path must NOT replace the exact resume: same worker count
+    restores local/optimizer/rule state bitwise (the round-2 contract)."""
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    with tempfile.TemporaryDirectory() as d:
+        def train(epochs, resume):
+            t = dk.DOWNPOUR(FlaxModel(MLP(features=(16,), num_classes=2)),
+                            loss="categorical_crossentropy",
+                            worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                            num_workers=4, batch_size=16, num_epoch=epochs,
+                            communication_window=4, seed=3,
+                            checkpoint_dir=d, resume=resume)
+            return t.train(df)
+
+        train(2, False)
+        resumed = train(4, True)  # 2 more epochs on top of the checkpoint
+
+    with tempfile.TemporaryDirectory() as d2:
+        t = dk.DOWNPOUR(FlaxModel(MLP(features=(16,), num_classes=2)),
+                        loss="categorical_crossentropy",
+                        worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                        num_workers=4, batch_size=16, num_epoch=4,
+                        communication_window=4, seed=3, checkpoint_dir=d2)
+        straight = t.train(df)
+
+    for a_, b_ in zip(jax.tree.leaves(resumed.params),
+                      jax.tree.leaves(straight.params)):
+        np.testing.assert_array_equal(np.asarray(a_), np.asarray(b_))

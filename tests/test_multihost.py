@@ -68,6 +68,13 @@ def test_four_process_gspmd_tensor_parallel():
 
 
 @pytest.mark.slow
+def test_two_process_fsdp_center_sharding():
+    # the ZeRO-3-sharded center spans both processes: each stores half the
+    # center variable; pull/commit gathers and scatters cross the wire
+    _run_processes(2, "fsdp")
+
+
+@pytest.mark.slow
 def test_two_process_pipeline_parallel():
     # the stages axis spans processes: ppermute activation hops and the
     # stage-sharded block params both cross the process boundary
