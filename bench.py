@@ -475,7 +475,16 @@ def _calibrate_reps(engine, state, xs, ys, min_set_seconds: float):
 
 
 def run_config(config: str, n_windows: int = 8, reps: int = None, k: int = 5,
-               num_workers=None, min_set_seconds: float = 0.5) -> dict:
+               num_workers=None, min_set_seconds: float = 2.0) -> dict:
+    # min_set_seconds=2.0: at 0.5 s sets the fixed ~23 ms tunnel dispatch is
+    # still ~4% of every set, and a back-to-back headline A/B on the TPU
+    # (same session, same program) measured 0.5 s sets at 183,350
+    # samples/s/chip with 26.5% set-to-set spread vs 2 s sets at 195,679
+    # with 0.7% — less environment overhead billed and far less variance.
+    # The committed sweep at this default is BENCH_full_r03.json / PERF.md
+    # par.6 (headline 196,105, spread 0.9%, MFU 0.587).  Streaming keeps
+    # its own smaller default: its epochs are link-bound through the
+    # tunnel and already tens of times longer.
     import jax
 
     engine, batch, window, shape, int_data, classes = _engine_for(config, num_workers)
