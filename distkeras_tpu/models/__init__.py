@@ -13,6 +13,7 @@ from distkeras_tpu.models.moe import (
     MoETransformerClassifier,
     expert_partition,
 )
+from distkeras_tpu.models.hf import HuggingFaceModel
 from distkeras_tpu.models.staged import StagedLM, StagedTransformer
 from distkeras_tpu.models.transformer import (
     TransformerClassifier,
@@ -41,4 +42,5 @@ __all__ = [
     "MoEEncoderBlock",
     "MoETransformerClassifier",
     "expert_partition",
+    "HuggingFaceModel",
 ]

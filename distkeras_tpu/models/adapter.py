@@ -164,6 +164,11 @@ def as_adapter(model) -> ModelAdapter:
         from distkeras_tpu.models.keras_adapter import KerasModel
 
         return KerasModel(model)
+    # transformers Flax model? (no transformers import needed)
+    if type(model).__module__.split(".")[0] == "transformers":
+        from distkeras_tpu.models.hf import HuggingFaceModel
+
+        return HuggingFaceModel(model)
     raise TypeError(
         f"cannot adapt {type(model)!r}: pass a Keras 3 model, flax.linen.Module, "
         "or distkeras_tpu ModelAdapter"

@@ -5,7 +5,23 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["accuracy", "token_accuracy", "get_metric"]
+__all__ = ["accuracy", "token_accuracy", "get_metric", "per_token_metric_names"]
+
+#: every alias get_metric resolves to the classifier accuracy — kept in one
+#: place so the per-token rewrite below can't drift from the registry
+_ACCURACY_ALIASES = ("accuracy", "acc", "categorical_accuracy")
+
+
+def per_token_metric_names(metrics):
+    """Canonicalise a metrics spec for per-token (LM) models: any classifier
+    accuracy alias becomes ``token_accuracy`` (its [B, T] labels would
+    otherwise be read as one-hot rows).  Callables pass through untouched."""
+    return tuple(
+        "token_accuracy"
+        if isinstance(m, str) and m.lower() in _ACCURACY_ALIASES
+        else m
+        for m in metrics
+    )
 
 
 def accuracy(preds, labels):
@@ -34,7 +50,7 @@ def get_metric(spec):
     if callable(spec):
         return spec
     name = str(spec).lower()
-    if name in ("accuracy", "acc", "categorical_accuracy"):
+    if name in _ACCURACY_ALIASES:
         return accuracy
     if name in ("token_accuracy", "lm_accuracy"):
         return token_accuracy

@@ -140,6 +140,10 @@ class WindowedEngine:
         Requires ``self.adapter`` and ``self.num_workers`` to be set."""
         self.optimizer = get_optimizer(worker_optimizer)
         self.loss_fn = get_loss(loss, from_logits=self.adapter.outputs_logits)
+        if getattr(self.adapter, "per_token_labels", False):
+            from distkeras_tpu.ops.metrics import per_token_metric_names
+
+            metrics = per_token_metric_names(metrics)
         self.metric_fns = [get_metric(m) for m in metrics]
         self.compute_dtype = compute_dtype
         # Rematerialise the forward pass on the backward (jax.checkpoint):

@@ -240,6 +240,12 @@ class Trainer:
         commit_schedule: Optional[np.ndarray] = None,
     ):
         adapter = as_adapter(self.master_model)
+        if getattr(adapter, "per_token_labels", False):
+            # keep history/TensorBoard keys aligned with the engine's
+            # accuracy -> token_accuracy canonicalisation for per-token models
+            from distkeras_tpu.ops.metrics import per_token_metric_names
+
+            self.metrics = per_token_metric_names(self.metrics)
         feats, labels = self._load_columns(dataframe)
         if self.pipeline_stages > 1:
             if self.tp_shards > 1 or self.seq_shards > 1 or self.fsdp:
