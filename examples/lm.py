@@ -1,7 +1,7 @@
 """Causal language modeling across the parallelism axes.
 
 Beyond the reference's classifier-only scope: trains a small causal
-transformer LM on a synthetic next-token corpus four ways —
+transformer LM on a synthetic next-token corpus five ways —
 
   1. data parallel            (TransformerLM, 4 workers)
   2. + sequence parallelism   (causal ring attention, per-token labels
@@ -9,6 +9,9 @@ transformer LM on a synthetic next-token corpus four ways —
   3. pipeline parallel        (StagedLM: GPipe-for-LM, 4 workers x 2 stages)
   4. tp + FSDP center         (GSPMD engine: embedding/head center copies
                                sharded over workers AND model axes)
+  5. HuggingFace fine-tune    (a transformers FlaxGPT2LMHeadModel through
+                               the same trainer — its params are the
+                               initial center, as from_pretrained's would be)
 
 — then greedily generates from the trained model.  Runs on a faked
 8-device CPU mesh so it works anywhere (delete the two config lines on
@@ -99,6 +102,23 @@ def main():
                                 num_layers=1, max_len=64)),
         worker_optimizer=("adam", {"learning_rate": 1e-3}),
         num_workers=4, tp_shards=2, fsdp=True, **common))
+
+    # 5. a HuggingFace Flax model through the identical trainer call —
+    #    swap the config-initialised model for .from_pretrained(...) to
+    #    fine-tune a real checkpoint
+    try:
+        from transformers import FlaxGPT2LMHeadModel, GPT2Config
+    except ImportError:
+        print("transformers not installed -- skipping the HF variant")
+    else:
+        hf = FlaxGPT2LMHeadModel(
+            GPT2Config(vocab_size=VOCAB, n_positions=SEQ, n_embd=32,
+                       n_layer=1, n_head=2, resid_pdrop=0.0,
+                       embd_pdrop=0.0, attn_pdrop=0.0),
+            seed=0, input_shape=(1, 8))
+        report("HF GPT-2 fine-tune (4w)", dk.DOWNPOUR(
+            hf, worker_optimizer=("adam", {"learning_rate": 3e-3}),
+            num_workers=4, **common))
 
     ctx = generate(trained, x[:1, :8])
     print("greedy generation:", ctx[0, 8:], "from context ending at", ctx[0, 7])

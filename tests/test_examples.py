@@ -54,7 +54,12 @@ def test_mnist_example_trainers_competitive():
 def test_lm_example_learns_and_generates():
     out = _run_example("lm.py", ["--epochs", "8"])
     accs = [float(v) for v in re.findall(r"token-acc ([0-9.]+)", out)]
-    assert len(accs) == 4 and all(a > 0.9 for a in accs), out
+    try:
+        import transformers  # noqa: F401 — optional dep mirrors the example
+        expected = 5
+    except ImportError:
+        expected = 4  # the example skips its HF variant without transformers
+    assert len(accs) == expected and all(a > 0.9 for a in accs), out
     gen = re.search(r"greedy generation: \[([0-9 ]+)\]", out)
     assert gen is not None, out
 
