@@ -75,6 +75,7 @@ def epoch_window_iter(
     *,
     rng: Optional[np.random.Generator] = None,
     pad_to_window: bool = True,
+    feature_dtype=None,
 ):
     """Lazily yield one epoch as per-window blocks
     ``[num_workers, window, batch, ...]`` — the streaming twin of
@@ -94,6 +95,12 @@ def epoch_window_iter(
     the final block may be ragged: the right shape for no-commit trainers,
     where block boundaries are arbitrary and extra padded steps would change
     the trajectory.
+
+    ``feature_dtype=bfloat16`` (with float32 features) emits each block
+    through the fused native gather+cast (``native.gather_rows_bf16``):
+    one pass over the data, half the bytes toward the device — the host
+    half of the streaming path's compute-dtype transfer.  Value-identical
+    to casting after the gather.
     """
     n = len(features)
     if n == 0:
@@ -114,11 +121,17 @@ def epoch_window_iter(
     idx2 = idx.reshape(num_workers, steps, batch_size)
     from distkeras_tpu import native
 
+    fused_bf16 = (
+        feature_dtype is not None
+        and np.dtype(feature_dtype).name == "bfloat16"
+        and np.issubdtype(features.dtype, np.floating)
+    )
+    gather_x = native.gather_rows_bf16 if fused_bf16 else native.gather_rows
     for w in range(n_windows):
         block = idx2[:, w * window : (w + 1) * window]
         cur = block.shape[1]  # < window only for a ragged final block
         sel = np.ascontiguousarray(block).ravel()
         block_shape = (num_workers, cur, batch_size)
-        xs = native.gather_rows(features, sel).reshape(block_shape + features.shape[1:])
+        xs = gather_x(features, sel).reshape(block_shape + features.shape[1:])
         ys = native.gather_rows(labels, sel).reshape(block_shape + labels.shape[1:])
         yield xs, ys

@@ -19,20 +19,18 @@
 
 namespace {
 
-// Parallel row gather: dst[i] = src[idx[i]] for rows of row_bytes bytes.
-void gather_rows_impl(const uint8_t* src, const int64_t* idx, uint8_t* dst,
-                      int64_t n_rows, int64_t row_bytes, int n_threads) {
+// Shared chunked thread pool: calls row_op(i) for every destination row i,
+// work-stealing in fixed chunks over n_threads threads.
+template <typename RowOp>
+void parallel_rows(int64_t n_rows, int64_t chunk, int n_threads, RowOp row_op) {
   if (n_threads < 1) n_threads = 1;
   std::atomic<int64_t> next{0};
-  const int64_t chunk = 256;
   auto work = [&] {
     for (;;) {
       int64_t start = next.fetch_add(chunk);
       if (start >= n_rows) return;
       int64_t end = start + chunk < n_rows ? start + chunk : n_rows;
-      for (int64_t i = start; i < end; ++i) {
-        std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
-      }
+      for (int64_t i = start; i < end; ++i) row_op(i);
     }
   };
   if (n_threads == 1) {
@@ -43,6 +41,38 @@ void gather_rows_impl(const uint8_t* src, const int64_t* idx, uint8_t* dst,
   threads.reserve(n_threads);
   for (int t = 0; t < n_threads; ++t) threads.emplace_back(work);
   for (auto& t : threads) t.join();
+}
+
+// Parallel row gather: dst[i] = src[idx[i]] for rows of row_bytes bytes.
+void gather_rows_impl(const uint8_t* src, const int64_t* idx, uint8_t* dst,
+                      int64_t n_rows, int64_t row_bytes, int n_threads) {
+  parallel_rows(n_rows, 256, n_threads, [&](int64_t i) {
+    std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+  });
+}
+
+// f32 -> bf16 with round-to-nearest-even, matching ml_dtypes/XLA (so the
+// fused gather+cast below is bit-identical to gather-then-astype).
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, sizeof(u));
+  if ((u & 0x7FFFFFFFu) > 0x7F800000u) {        // NaN: quiet, keep sign
+    return static_cast<uint16_t>((u >> 16) | 0x0040u);
+  }
+  uint32_t rounding_bias = 0x7FFFu + ((u >> 16) & 1u);
+  return static_cast<uint16_t>((u + rounding_bias) >> 16);
+}
+
+// Fused permutation-gather + f32->bf16 cast: dst[i] = bf16(src[idx[i]]).
+// One pass instead of gather-f32 (write N) then astype (read N, write N/2) —
+// the host half of the streaming path's compute-dtype transfer.
+void gather_rows_bf16_impl(const float* src, const int64_t* idx, uint16_t* dst,
+                           int64_t n_rows, int64_t row_elems, int n_threads) {
+  parallel_rows(n_rows, 64, n_threads, [&](int64_t i) {
+    const float* s = src + idx[i] * row_elems;
+    uint16_t* d = dst + i * row_elems;
+    for (int64_t j = 0; j < row_elems; ++j) d[j] = f32_to_bf16(s[j]);
+  });
 }
 
 }  // namespace
@@ -73,6 +103,14 @@ void dk_shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
   }
 }
 
-int dk_version() { return 1; }
+// Fused gather + f32->bf16 cast; row_elems = floats per row.
+void dk_gather_rows_bf16(const void* src, const int64_t* idx, void* dst,
+                         int64_t n_rows, int64_t row_elems, int n_threads) {
+  gather_rows_bf16_impl(static_cast<const float*>(src), idx,
+                        static_cast<uint16_t*>(dst), n_rows, row_elems,
+                        n_threads);
+}
+
+int dk_version() { return 2; }
 
 }  // extern "C"

@@ -755,7 +755,10 @@ class WindowedEngine:
         def put(block):
             xs, ys = block
             if cast is not None and jnp.issubdtype(xs.dtype, jnp.floating):
-                xs = xs.astype(cast)
+                # copy=False: blocks from the fused native gather+cast
+                # (data.epoch_window_iter(feature_dtype=...)) arrive already
+                # in the compute dtype — don't pay a second host copy
+                xs = xs.astype(cast, copy=False)
             return self.shard_batches(xs[:, None], ys[:, None])
 
         it = iter(window_iter)

@@ -428,6 +428,7 @@ class Trainer:
                     feats, labels, num_workers, self.batch_size, stream_window,
                     rng=rng if shuffle else None,
                     pad_to_window=window is not None,
+                    feature_dtype=self.compute_dtype,
                 )
                 run_one = lambda blocks=blocks: engine.run_epoch_streaming(state, blocks)
             else:

@@ -51,3 +51,61 @@ def test_fallback_path(monkeypatch):
     np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
     perm = native.shuffle_indices(100, seed=1)
     np.testing.assert_array_equal(np.sort(perm), np.arange(100))
+
+
+def test_gather_rows_bf16_bitwise_matches_mldtypes():
+    """The fused native gather+cast must round f32->bf16 exactly like
+    ml_dtypes (round-to-nearest-even), including the nasty values."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    rng = np.random.default_rng(0)
+    src = rng.normal(size=(64, 33)).astype(np.float32) * rng.choice(
+        [1e-40, 1e-20, 1.0, 1e20], size=(64, 1)
+    ).astype(np.float32)
+    # plant the edge cases: infs, NaN, zeros, tie-rounding values, denormals
+    src[0, :8] = [np.inf, -np.inf, np.nan, 0.0, -0.0, 1.0, -1.0, 3.14159]
+    src[1, :4] = np.array(
+        [1.00390625, 1.01171875, 65535.0, 5.877e-39], dtype=np.float32
+    )
+    idx = rng.integers(0, 64, size=200)
+    got = native.gather_rows_bf16(src, idx)
+    want = src[idx].astype(bf16)
+    assert got.dtype == want.dtype
+    np.testing.assert_array_equal(
+        got.view(np.uint16), want.view(np.uint16)
+    )
+
+
+def test_gather_rows_bf16_fallback(monkeypatch):
+    import ml_dtypes
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    src = np.arange(24, dtype=np.float32).reshape(6, 4)
+    idx = np.array([5, 0, 3])
+    out = native.gather_rows_bf16(src, idx)
+    assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(
+        out.astype(np.float32), src[idx].astype(ml_dtypes.bfloat16).astype(np.float32)
+    )
+
+
+def test_window_iter_fused_bf16_matches_cast_after_gather(toy_classification):
+    import jax.numpy as jnp
+
+    from distkeras_tpu.data import epoch_window_iter
+
+    x, y, onehot = toy_classification
+    a = list(epoch_window_iter(x, onehot, 4, 8, 2,
+                               rng=np.random.default_rng(1),
+                               feature_dtype=jnp.bfloat16))
+    b = list(epoch_window_iter(x, onehot, 4, 8, 2,
+                               rng=np.random.default_rng(1)))
+    assert len(a) == len(b)
+    for (ax, ay), (bx, by) in zip(a, b):
+        assert ax.dtype == np.dtype(jnp.bfloat16)
+        np.testing.assert_array_equal(
+            ax.view(np.uint16), bx.astype(jnp.bfloat16).view(np.uint16)
+        )
+        np.testing.assert_array_equal(ay, by)

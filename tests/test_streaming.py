@@ -110,6 +110,30 @@ def test_streaming_bf16_transfer_bit_identical(toy_classification):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_trainer_streaming_bf16_fused_gather_matches_in_memory(toy_classification):
+    """Trainer-level: streaming with compute_dtype=bf16 rides the fused
+    native gather+cast (data.epoch_window_iter(feature_dtype=...)) and
+    still reproduces the in-memory trajectory bit-for-bit."""
+    import jax.numpy as jnp
+
+    x, y, onehot = toy_classification
+
+    def train(streaming):
+        t = dk.DOWNPOUR(FlaxModel(MLP(features=(16,), num_classes=2)),
+                        loss="categorical_crossentropy",
+                        worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                        num_workers=4, batch_size=16, num_epoch=2,
+                        communication_window=4, seed=5, streaming=streaming,
+                        compute_dtype=jnp.bfloat16)
+        return t.train(from_numpy(x, onehot))
+
+    a, b = train(False), train(True)
+    flat_a, flat_b = jax.tree.leaves(a.params), jax.tree.leaves(b.params)
+    assert len(flat_a) == len(flat_b)
+    for pa, pb in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
 def test_trainer_streaming_kwarg_matches_in_memory(toy_classification):
     x, y, onehot = toy_classification
 
