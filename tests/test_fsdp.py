@@ -133,6 +133,25 @@ def test_trainer_fsdp_kwarg_converges(toy_classification):
     assert np.mean(preds == np.argmax(onehot, -1)) > 0.8
 
 
+def test_fsdp_virtual_workers():
+    """More logical workers than devices (parallelism_factor) with a
+    ZeRO-sharded center: 16 logical on the 8-device mesh compute the same
+    trajectory as 16 plain data-parallel workers."""
+    x, y, onehot = _data(n=512)
+    adapter = lambda: FlaxModel(MLP(features=(32,), num_classes=4))
+    xs, ys = _epoch_arrays(x, onehot, num_workers=16, n_windows=1, window=4, batch=8)
+
+    fs = GSPMDEngine(adapter(), "categorical_crossentropy", "sgd", Downpour(4),
+                     num_workers=16, fsdp=True, metrics=())
+    assert fs.virtual == 2  # over-partitioning actually engaged (16 on 8)
+    dp = WindowedEngine(adapter(), "categorical_crossentropy", "sgd", Downpour(4),
+                        num_workers=16, metrics=())
+    p_fs, loss_fs = _run(fs, xs, ys, x[:8], epochs=1)
+    p_dp, loss_dp = _run(dp, xs, ys, x[:8], epochs=1)
+    _assert_trees_close(p_dp, p_fs)
+    np.testing.assert_allclose(loss_dp, loss_fs, rtol=2e-5, atol=2e-6)
+
+
 def test_fsdp_staleness_schedule():
     """The per-step masked-commit (staleness simulation) body also runs with
     a sharded center: DynSGD under a skewed commit schedule stays finite."""
