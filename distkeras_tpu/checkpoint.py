@@ -27,6 +27,7 @@ __all__ = [
 ]
 
 _CHECKPOINTER = None
+_PYTREE_CHECKPOINTER = None
 
 
 def _checkpointer():
@@ -40,6 +41,18 @@ def _checkpointer():
 
         _CHECKPOINTER = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
     return _CHECKPOINTER
+
+
+def _pytree_checkpointer():
+    """Singleton synchronous PyTree checkpointer for the partial
+    (PLACEHOLDER) restores — built once, like :func:`_checkpointer`, instead
+    of leaking a fresh instance per elastic resume."""
+    global _PYTREE_CHECKPOINTER
+    if _PYTREE_CHECKPOINTER is None:
+        import orbax.checkpoint as ocp
+
+        _PYTREE_CHECKPOINTER = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    return _PYTREE_CHECKPOINTER
 
 
 def wait_until_finished() -> None:
@@ -103,10 +116,22 @@ def _step_path(directory: str, step: Optional[int]) -> str:
     return os.path.join(os.path.abspath(directory), f"step_{step}")
 
 
-def _metadata_tree(path: str):
+def _metadata_tree(path: str) -> dict:
     meta = _checkpointer().metadata(path)
     tree = getattr(meta, "item_metadata", meta)
-    return getattr(tree, "tree", tree)
+    tree = getattr(tree, "tree", tree)
+    if not isinstance(tree, dict):
+        # the getattr chain above tracks Orbax's metadata API (validated
+        # against orbax-checkpoint 0.11.x); a release that reshapes it again
+        # should fail here by name, not with a KeyError downstream
+        raise RuntimeError(
+            "could not read the checkpoint metadata tree as a dict (got "
+            f"{type(tree).__name__}) — the installed orbax-checkpoint "
+            "version exposes an unexpected metadata layout; "
+            "distkeras_tpu.checkpoint expects the 0.11.x "
+            "item_metadata/.tree API"
+        )
+    return tree
 
 
 def restore_center(directory: str, step: Optional[int] = None) -> dict:
@@ -132,7 +157,7 @@ def restore_center(directory: str, step: Optional[int] = None) -> dict:
     # PLACEHOLDER is a PyTree-handler feature (the Standard handler rejects
     # it); both handlers share the on-disk format, so reading a
     # StandardSave checkpoint through PyTreeRestore is exact.
-    restored = ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).restore(
+    restored = _pytree_checkpointer().restore(
         path, args=ocp.args.PyTreeRestore(item=template)
     )
     return {k: restored[k] for k in keep}

@@ -186,7 +186,14 @@ class GSPMDEngine(WindowedEngine):
         replicated (correct either way; sharding is a layout choice)."""
         spec = list(self._tp_spec(shape, path))
         spec += [None] * (len(shape) - len(spec))
-        if self.fsdp and self.n_dev > 1:
+        taken = {
+            n for entry in spec if entry is not None
+            for n in (entry if isinstance(entry, tuple) else (entry,))
+        }
+        # A custom spec_fn may already have placed the workers axis (e.g. an
+        # FSDP-style override); assigning it a second dim would be an invalid
+        # PartitionSpec surfacing as an opaque partitioner error.
+        if self.fsdp and self.n_dev > 1 and WORKER_AXIS not in taken:
             free = [
                 d for d, name in enumerate(spec)
                 if name is None and shape[d] % self.n_dev == 0
@@ -208,9 +215,21 @@ class GSPMDEngine(WindowedEngine):
         """Per-worker trees ([num_workers, ...] leaves): workers axis on dim 0
         plus the TP spec of the per-worker shape."""
 
+        def strip_workers(entry):
+            # spec_fn may place WORKER_AXIS (FSDP-style override) — valid for
+            # center leaves, but per-worker leaves already spend the workers
+            # axis on their leading dim, so it must come out of the TP spec
+            if entry == WORKER_AXIS:
+                return None
+            if isinstance(entry, tuple):
+                rest = tuple(n for n in entry if n != WORKER_AXIS)
+                return rest if rest else None
+            return entry
+
         def one(path, x):
             if x.ndim >= 1 and x.shape[0] == self.num_workers:
-                spec = P(WORKER_AXIS, *self._tp_spec(x.shape[1:], self._key_names(path)))
+                tp = self._tp_spec(x.shape[1:], self._key_names(path))
+                spec = P(WORKER_AXIS, *(strip_workers(e) for e in tp))
             else:
                 spec = P()
             return lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))

@@ -219,11 +219,17 @@ class Trainer:
         raw = ckpt.restore_center()  # elastic: only center/rule/epoch read
         epoch = int(np.asarray(raw["epoch"]))
         # per-worker model state (BatchNorm stats) collapses to its mean —
-        # the same semantic sync_model_state applies at every commit
-        model_state = jax.tree.map(
-            lambda x: np.asarray(x).mean(axis=0).astype(np.asarray(x).dtype),
-            raw["model_state"],
-        )
+        # the same semantic sync_model_state applies at every commit.  Mean
+        # in float64 so bf16 leaves don't round twice, and integer leaves
+        # (step/count statistics) round to nearest instead of truncating.
+        def _worker_mean(x):
+            x = np.asarray(x)
+            m = x.astype(np.float64).mean(axis=0)
+            if np.issubdtype(x.dtype, np.integer):
+                m = np.rint(m)
+            return m.astype(x.dtype)
+
+        model_state = jax.tree.map(_worker_mean, raw["model_state"])
         return engine.state_from_center(
             jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch),
             raw["center_params"], raw["center_rule"], model_state, epoch,
@@ -240,12 +246,15 @@ class Trainer:
         commit_schedule: Optional[np.ndarray] = None,
     ):
         adapter = as_adapter(self.master_model)
+        # Local canonicalised copy: per-token models rename accuracy ->
+        # token_accuracy so history/TensorBoard keys match the engine's
+        # metric names, WITHOUT mutating the user-visible self.metrics the
+        # caller constructed the trainer with.
+        metrics = self.metrics
         if getattr(adapter, "per_token_labels", False):
-            # keep history/TensorBoard keys aligned with the engine's
-            # accuracy -> token_accuracy canonicalisation for per-token models
             from distkeras_tpu.ops.metrics import per_token_metric_names
 
-            self.metrics = per_token_metric_names(self.metrics)
+            metrics = per_token_metric_names(metrics)
         feats, labels = self._load_columns(dataframe)
         if self.pipeline_stages > 1:
             if self.tp_shards > 1 or self.seq_shards > 1 or self.fsdp:
@@ -274,7 +283,7 @@ class Trainer:
                 rule,
                 num_workers,
                 microbatches=self.pp_microbatches,
-                metrics=self.metrics,
+                metrics=metrics,
                 compute_dtype=self.compute_dtype,
                 remat=self.remat,
                 unroll=self.unroll,
@@ -296,7 +305,7 @@ class Trainer:
                 tp_shards=self.tp_shards,
                 fsdp=self.fsdp,
                 spec_fn=self.tp_spec_fn,
-                metrics=self.metrics,
+                metrics=metrics,
                 compute_dtype=self.compute_dtype,
                 commit_schedule=commit_schedule,
                 remat=self.remat,
@@ -309,7 +318,7 @@ class Trainer:
                 self._effective_worker_optimizer(),
                 rule,
                 num_workers,
-                metrics=self.metrics,
+                metrics=metrics,
                 compute_dtype=self.compute_dtype,
                 commit_schedule=commit_schedule,
                 seq_shards=self.seq_shards,
@@ -378,7 +387,7 @@ class Trainer:
                 mets = np.asarray(stats["metrics"])
                 if mets.size:
                     per_metric = np.mean(mets, axis=0)
-                    for i, name in enumerate(self.metrics):
+                    for i, name in enumerate(metrics):
                         key = name if isinstance(name, str) else getattr(name, "__name__", f"metric_{i}")
                         scalars[key] = float(per_metric[i])
                 scalar_log.log(epoch_idx, **scalars)
@@ -483,7 +492,7 @@ class Trainer:
         self.record_training_stop()
 
         self.history = {"loss": losses_per_epoch, "training_time": self.get_training_time()}
-        for i, name in enumerate(self.metrics):
+        for i, name in enumerate(metrics):
             if metrics_per_epoch:
                 key = name if isinstance(name, str) else getattr(name, "__name__", f"metric_{i}")
                 self.history[key] = [float(m[i]) for m in metrics_per_epoch]
