@@ -22,7 +22,8 @@ import jax
 import numpy as np
 
 __all__ = [
-    "save_checkpoint", "restore_checkpoint", "restore_center", "latest_step",
+    "save_checkpoint", "restore_checkpoint", "restore_center",
+    "model_state_worker_mean", "latest_step",
     "checkpoint_num_workers", "CheckpointManager",
 ]
 
@@ -134,17 +135,27 @@ def _metadata_tree(path: str) -> dict:
     return tree
 
 
-def restore_center(directory: str, step: Optional[int] = None) -> dict:
+def restore_center(
+    directory: str, step: Optional[int] = None,
+    include_model_state: bool = True,
+) -> dict:
     """Partial restore for elastic resume: only the center variable, its
     rule state, the model state, and the epoch counter leave disk; the
     per-worker subtrees (local replicas, optimizer state, rule locals,
     rngs) — ~3N x the model size at N workers — restore as Orbax
-    placeholders, i.e. are never read."""
+    placeholders, i.e. are never read.
+
+    ``include_model_state=False`` additionally placeholders the per-worker
+    ``[N, ...]`` model-state stack — pair with
+    :func:`model_state_worker_mean`, which reduces that stack leaf by leaf
+    instead of materialising all of it at once."""
     import orbax.checkpoint as ocp
 
     path = _step_path(directory, step)
     tree = _metadata_tree(path)
-    keep = ("center_params", "center_rule", "model_state", "epoch")
+    keep = ("center_params", "center_rule", "epoch")
+    if include_model_state:
+        keep = keep + ("model_state",)
 
     def template_for(key, sub):
         if key in keep:
@@ -161,6 +172,77 @@ def restore_center(directory: str, step: Optional[int] = None) -> dict:
         path, args=ocp.args.PyTreeRestore(item=template)
     )
     return {k: restored[k] for k in keep}
+
+
+def worker_mean(x: np.ndarray) -> np.ndarray:
+    """Mean over the leading (workers) axis with resume-grade dtype care:
+    accumulate in float64 (bf16 leaves don't round twice), round integer
+    leaves to nearest instead of truncating."""
+    x = np.asarray(x)
+    m = x.astype(np.float64).mean(axis=0)
+    if np.issubdtype(x.dtype, np.integer):
+        m = np.rint(m)
+    return m.astype(x.dtype)
+
+
+def model_state_worker_mean(
+    directory: str, step: Optional[int] = None,
+    host_bytes_budget: int = 256 * 1024**2,
+):
+    """Collapse the checkpointed per-worker ``[N_old, ...]`` model-state
+    stack to its worker mean WITHOUT materialising the whole stack on host.
+
+    Elastic resume at a new worker count needs only the mean (the same
+    semantic ``sync_model_state`` applies at every commit), but a naive
+    restore reads all ``N_old x`` model-state bytes into one host tree —
+    for large stateful models exactly the host spike the sharded training
+    path avoids.  Instead leaves restore in groups whose combined stack
+    size stays under ``host_bytes_budget`` (every other array in the
+    checkpoint is an Orbax PLACEHOLDER, i.e. never read) and reduce
+    immediately, bounding peak host memory without paying one serial
+    restore round-trip per leaf on deeply-stateful models (asserted by the
+    restore-spy test in tests/test_elastic.py)."""
+    import orbax.checkpoint as ocp
+    from jax import tree_util as jtu
+
+    path = _step_path(directory, step)
+    tree = _metadata_tree(path)
+    sub = tree.get("model_state", {})
+    meta_leaves, treedef = jtu.tree_flatten(sub)
+    others_placeholder = {
+        k: jax.tree.map(lambda m: ocp.PLACEHOLDER, v)
+        for k, v in tree.items() if k != "model_state"
+    }
+    # greedy grouping: combined bytes per restore <= budget (single
+    # over-budget leaves still restore alone — that bound is irreducible)
+    groups, cur, cur_bytes = [], [], 0
+    for i, m in enumerate(meta_leaves):
+        nbytes = int(np.prod(m.shape, dtype=np.int64)) * np.dtype(m.dtype).itemsize
+        if cur and cur_bytes + nbytes > host_bytes_budget:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        groups.append(cur)
+    out = [None] * len(meta_leaves)
+    for group in groups:
+        live = set(group)
+        sub_tpl = jtu.tree_unflatten(treedef, [
+            jax.ShapeDtypeStruct(tuple(m.shape), m.dtype) if j in live
+            else ocp.PLACEHOLDER
+            for j, m in enumerate(meta_leaves)
+        ])
+        restored = _pytree_checkpointer().restore(
+            path,
+            args=ocp.args.PyTreeRestore(
+                item=dict(others_placeholder, model_state=sub_tpl)
+            ),
+        )
+        flat = jtu.tree_flatten(restored["model_state"])[0]
+        for i in group:
+            out[i] = worker_mean(flat[i])
+    return jtu.tree_unflatten(treedef, out)
 
 
 def checkpoint_num_workers(directory: str, step: Optional[int] = None) -> int:
@@ -218,8 +300,16 @@ class CheckpointManager:
     def saved_worker_count(self, step: Optional[int] = None) -> int:
         return checkpoint_num_workers(self.directory, step)
 
-    def restore_center(self, step: Optional[int] = None) -> dict:
-        return restore_center(self.directory, step)
+    def restore_center(
+        self, step: Optional[int] = None, include_model_state: bool = True,
+    ) -> dict:
+        return restore_center(self.directory, step, include_model_state)
+
+    def model_state_worker_mean(
+        self, step: Optional[int] = None,
+        host_bytes_budget: int = 256 * 1024**2,
+    ):
+        return model_state_worker_mean(self.directory, step, host_bytes_budget)
 
     def restore(self, like: Any = None, step: Optional[int] = None) -> Any:
         return restore_checkpoint(self.directory, step, like)

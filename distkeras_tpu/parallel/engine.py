@@ -83,6 +83,14 @@ class TrainState:
 class WindowedEngine:
     """Builds and owns the jitted epoch functions for one (model, rule) pair."""
 
+    # Mesh axes the engine's shard_map programs are *manual* over (hand-
+    # placed collectives).  Empty = all axes (jax.shard_map's default).  The
+    # pipeline engine under tensor parallelism sets this to (workers, stages)
+    # so its third mesh axis stays *auto*: XLA's SPMD partitioner partitions
+    # the stage matmuls from the state's model-axis shardings while the
+    # ppermute pipeline and commit psums stay hand-written.
+    _manual_axes: frozenset = frozenset()
+
     def __init__(
         self,
         adapter: ModelAdapter,
@@ -460,6 +468,7 @@ class WindowedEngine:
             in_specs=(center_spec, center_rule_spec, local_spec, xs_spec, ys_spec),
             out_specs=(center_spec, center_rule_spec, local_spec, P(), P()),
             check_vma=False,
+            **({"axis_names": self._manual_axes} if self._manual_axes else {}),
         )
 
         def epoch_fn(state: TrainState, xs, ys):
@@ -625,6 +634,7 @@ class WindowedEngine:
                       P(self.axis)),
             out_specs=(center_spec, center_rule_spec, local_spec, P()),
             check_vma=False,
+            **({"axis_names": self._manual_axes} if self._manual_axes else {}),
         )
 
         schedule_arr = jnp.asarray(self.commit_schedule, jnp.int32)
@@ -763,15 +773,15 @@ class WindowedEngine:
 
         it = iter(window_iter)
         buf = deque()
-        for _ in range(max(1, prefetch)):
-            block = next(it, None)
-            if block is None:
-                break
-            buf.append(put(block))
         losses, mets = [], []
         n_windows = 0
         depth = max(1, prefetch)
-        while buf:
+        while True:
+            if not buf:
+                block = next(it, None)
+                if block is None:
+                    break
+                buf.append(put(block))
             xs, ys = buf.popleft()
             state, stats = self.run_epoch(state, xs, ys)  # async dispatch
             n_windows += 1
@@ -784,8 +794,14 @@ class WindowedEngine:
             # up to prefetch buffered undispatched blocks — see docstring).
             if n_windows > depth:
                 jax.block_until_ready(losses[n_windows - 1 - depth])
-            block = next(it, None)
-            if block is not None:
+            # Refill AFTER dispatching (first window included): the very
+            # first window's compute then hides the rest of the initial
+            # prefill's source latency — measured, not assumed, in
+            # tests/test_streaming_overlap.py.
+            while len(buf) < depth:
+                block = next(it, None)
+                if block is None:
+                    break
                 buf.append(put(block))
         if not losses:
             raise ValueError("empty window iterator")

@@ -58,9 +58,27 @@ from distkeras_tpu.parallel.engine import (
 )
 from distkeras_tpu.parallel.mesh import WORKER_AXIS
 
-__all__ = ["GSPMDEngine", "TP_AXIS"]
+__all__ = ["GSPMDEngine", "TP_AXIS", "default_tp_dim"]
 
 TP_AXIS = "model"
+
+
+def default_tp_dim(shape, tp_shards: int):
+    """The ONE default tensor-parallel placement rule, shared by every
+    engine that shards over a model axis (GSPMD default spec, pipeline
+    staged-leaf tails): shard the LAST dim of any >=2-D leaf that splits
+    evenly and is at least two lanes per shard; return its index or None.
+    Any placement is *correct* under GSPMD — this default puts matmul
+    output channels (Dense/Conv kernels, embeddings) on the model axis,
+    Megatron column-parallel style."""
+    if (
+        tp_shards > 1
+        and len(shape) >= 2
+        and shape[-1] % tp_shards == 0
+        and shape[-1] >= 2 * tp_shards
+    ):
+        return len(shape) - 1
+    return None
 
 
 class GSPMDEngine(WindowedEngine):
@@ -163,13 +181,9 @@ class GSPMDEngine(WindowedEngine):
         # tp_shards == 1: a size-1 model axis is a layout no-op, but naming it
         # would block _center_spec from giving that dim to the workers axis
         # under fsdp — leave every dim free instead.
-        if (
-            self.tp_shards > 1
-            and len(shape) >= 2
-            and shape[-1] % self.tp_shards == 0
-            and shape[-1] >= 2 * self.tp_shards
-        ):
-            return P(*([None] * (len(shape) - 1)), TP_AXIS)
+        dim = default_tp_dim(tuple(shape), self.tp_shards)
+        if dim is not None:
+            return P(*([None] * dim), TP_AXIS)
         return P()
 
     @staticmethod

@@ -59,22 +59,24 @@ def make_mesh(
 
 
 def make_mesh_grid(
-    num_worker_devices: int,
-    seq_shards: int,
+    *dims: int,
     axis_names: tuple = (WORKER_AXIS, SEQ_AXIS),
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """2-D mesh for combined data x sequence parallelism: worker-local state
-    shards over the first axis, long sequences over the second (ring
-    attention's neighbour hops ride ICI)."""
+    """N-D mesh grid, one named axis per dim: (workers, seq) for data x
+    sequence parallelism (ring attention's neighbour hops ride ICI),
+    (workers, stages) for the pipeline, (workers, stages, model) for the
+    three-axis dp x pp x tp composition."""
+    if len(dims) != len(axis_names):
+        raise ValueError(f"{len(dims)} mesh dims for axis names {axis_names}")
     devices = list(devices if devices is not None else jax.devices())
-    need = num_worker_devices * seq_shards
+    need = int(np.prod(dims))
     if need > len(devices):
         raise ValueError(
-            f"mesh {num_worker_devices}x{seq_shards} needs {need} devices, "
+            f"mesh {'x'.join(map(str, dims))} needs {need} devices, "
             f"have {len(devices)}"
         )
-    grid = np.array(devices[:need]).reshape(num_worker_devices, seq_shards)
+    grid = np.array(devices[:need]).reshape(dims)
     return Mesh(grid, axis_names)
 
 

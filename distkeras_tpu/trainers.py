@@ -205,7 +205,7 @@ class Trainer:
             labels = labels_raw.astype(np.float32)
         return feats, labels
 
-    def _restore_state(self, ckpt, engine, state, elastic: bool):
+    def _restore_state(self, ckpt, engine, state, elastic: bool, step=None):
         """Resume from ``checkpoint_dir``: bitwise when the checkpoint was
         written at this trainer's worker count; **elastic** otherwise — the
         restored center variable (and its commit counters and epoch) carry
@@ -215,21 +215,17 @@ class Trainer:
         reference: upstream had no way to continue a run on a different
         cluster size at all."""
         if not elastic:
-            return ckpt.restore(like=state)  # bitwise path, single read
-        raw = ckpt.restore_center()  # elastic: only center/rule/epoch read
+            return ckpt.restore(like=state, step=step)  # bitwise, single read
+        # elastic: only center/rule/epoch read here; the per-worker
+        # [N_old, ...] model-state stack never materialises whole — it
+        # reduces to its worker mean in budget-bounded partial restores
+        # (checkpoint.model_state_worker_mean), the same semantic
+        # sync_model_state applies at every commit.  Both reads pin the
+        # step resolved in _fit, so a save landing mid-resume cannot mix
+        # checkpoints.
+        raw = ckpt.restore_center(step, include_model_state=False)
         epoch = int(np.asarray(raw["epoch"]))
-        # per-worker model state (BatchNorm stats) collapses to its mean —
-        # the same semantic sync_model_state applies at every commit.  Mean
-        # in float64 so bf16 leaves don't round twice, and integer leaves
-        # (step/count statistics) round to nearest instead of truncating.
-        def _worker_mean(x):
-            x = np.asarray(x)
-            m = x.astype(np.float64).mean(axis=0)
-            if np.issubdtype(x.dtype, np.integer):
-                m = np.rint(m)
-            return m.astype(x.dtype)
-
-        model_state = jax.tree.map(_worker_mean, raw["model_state"])
+        model_state = ckpt.model_state_worker_mean(step)
         return engine.state_from_center(
             jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch),
             raw["center_params"], raw["center_rule"], model_state, epoch,
@@ -257,10 +253,17 @@ class Trainer:
             metrics = per_token_metric_names(metrics)
         feats, labels = self._load_columns(dataframe)
         if self.pipeline_stages > 1:
-            if self.tp_shards > 1 or self.seq_shards > 1 or self.fsdp:
+            if self.seq_shards > 1 or self.fsdp:
                 raise ValueError(
-                    "pipeline_stages>1 composes with data parallelism only "
-                    "(not tp_shards/seq_shards/fsdp in this release)"
+                    "pipeline_stages>1 composes with data parallelism and "
+                    "tensor parallelism (tp_shards); seq_shards/fsdp are not "
+                    "supported with the pipeline engine in this release"
+                )
+            if self.tp_spec_fn is not None:
+                raise ValueError(
+                    "tp_spec_fn is a GSPMD-engine override; the pipeline "
+                    "engine places the model axis by its staged-leaf shape "
+                    "rule"
                 )
             if commit_schedule is not None:
                 raise ValueError(
@@ -283,6 +286,7 @@ class Trainer:
                 rule,
                 num_workers,
                 microbatches=self.pp_microbatches,
+                tp_shards=self.tp_shards,
                 metrics=metrics,
                 compute_dtype=self.compute_dtype,
                 remat=self.remat,
@@ -336,8 +340,12 @@ class Trainer:
             from distkeras_tpu.checkpoint import CheckpointManager
 
             ckpt = CheckpointManager(self.checkpoint_dir, every=self.checkpoint_every)
-            resuming = self.resume and ckpt.latest() is not None
-            elastic = resuming and ckpt.saved_worker_count() != engine.num_workers
+            # resolve the resume step ONCE; every read below pins it, so a
+            # concurrent writer (second elastic job, in-flight async save)
+            # cannot hand different reads different checkpoints
+            resume_step = ckpt.latest() if self.resume else None
+            resuming = resume_step is not None
+            elastic = resuming and ckpt.saved_worker_count(resume_step) != engine.num_workers
             if elastic and rule.communication_window <= 0:
                 # no-commit rules (Sequential/OneShotAverage) never fold
                 # progress into the center mid-training, so an elastic
@@ -345,7 +353,7 @@ class Trainer:
                 # nonzero epoch counter — refuse loudly instead
                 raise ValueError(
                     f"elastic resume (checkpoint at "
-                    f"{ckpt.saved_worker_count()} workers, trainer at "
+                    f"{ckpt.saved_worker_count(resume_step)} workers, trainer at "
                     f"{engine.num_workers}) requires a committing rule; "
                     f"{type(rule).__name__} only produces its result at the "
                     "end of training, so the checkpointed center carries no "
@@ -364,7 +372,7 @@ class Trainer:
                 jax.random.PRNGKey(self.seed), feats[: self.batch_size]
             )
         if resuming:
-            state = self._restore_state(ckpt, engine, state, elastic)
+            state = self._restore_state(ckpt, engine, state, elastic, step=resume_step)
             start_epoch = int(np.asarray(state.epoch))
 
         # keep the host RNG stream aligned with the epoch counter on resume

@@ -179,9 +179,9 @@ def test_trainer_pipeline_kwarg_validation():
 
     x, _, onehot = toy_text(n=32)
     df = dk.from_numpy(x, onehot)
-    t = dk.DOWNPOUR(_staged(num_stages=4), pipeline_stages=4, tp_shards=2,
+    t = dk.DOWNPOUR(_staged(num_stages=4), pipeline_stages=4, fsdp=True,
                     num_workers=2, batch_size=8, num_epoch=1)
-    with pytest.raises(ValueError, match="composes with data parallelism"):
+    with pytest.raises(ValueError, match="seq_shards/fsdp are not"):
         t.train(df)
     from distkeras_tpu.models import TextCNN
     t2 = dk.DOWNPOUR(FlaxModel(TextCNN(vocab_size=50, num_classes=2)),
