@@ -14,6 +14,7 @@ from distkeras_tpu.models.moe import (
     expert_partition,
 )
 from distkeras_tpu.models.hf import HuggingFaceModel
+from distkeras_tpu.models.generate import greedy_generate
 from distkeras_tpu.models.staged import StagedLM, StagedTransformer
 from distkeras_tpu.models.transformer import (
     TransformerClassifier,
@@ -38,6 +39,7 @@ __all__ = [
     "TransformerLM",
     "StagedTransformer",
     "StagedLM",
+    "greedy_generate",
     "MoEFeedForward",
     "MoEEncoderBlock",
     "MoETransformerClassifier",

@@ -43,9 +43,9 @@ class _Embed(nn.Module):
     max_len: int
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, offset=0):
         tokens = tokens.astype(jnp.int32)
-        positions = jnp.arange(tokens.shape[1])
+        positions = offset + jnp.arange(tokens.shape[1])
         x = nn.Embed(self.vocab_size, self.dim, name="tok_embed")(tokens)
         return x + nn.Embed(self.max_len, self.dim, name="pos_embed")(positions)[None]
 
@@ -124,8 +124,8 @@ class StagedTransformer(ModelAdapter):
 
     # ------------------------------------------------- stage pieces (public
     # to the pipeline engine; all pure functions of explicit params)
-    def embed(self, embed_params, tokens):
-        return self._embed.apply({"params": embed_params}, tokens)
+    def embed(self, embed_params, tokens, offset=0):
+        return self._embed.apply({"params": embed_params}, tokens, offset)
 
     def stage(self, stage_params, h):
         """Apply one stage: scan ``blocks_per_stage`` blocks whose param
@@ -174,7 +174,44 @@ class StagedLM(StagedTransformer):
         super().__post_init__()
 
     def _make_block(self):
-        return TransformerEncoderBlock(self.dim, self.heads, causal=True)
+        # max_len sizes the per-block KV cache for decode (training ignores it)
+        return TransformerEncoderBlock(self.dim, self.heads, causal=True,
+                                       max_len=self.max_len)
 
     def _make_head(self):
         return _LMHead(self.vocab_size)
+
+    # ------------------------------------------------------- KV-cache decode
+    def init_cache(self, batch_size: int, dtype=jnp.float32):
+        """Zeroed per-block KV caches, stacked ``[n_blocks, ...]`` to scan
+        with the flat block stack in :meth:`decode_step`."""
+        dummy = jnp.zeros((batch_size, 1, self.dim), dtype)
+        shapes = jax.eval_shape(
+            lambda: self._block.init(jax.random.PRNGKey(0), dummy, decode=True)
+        )["cache"]
+        n_blocks = self.num_stages * self.blocks_per_stage
+        return jax.tree.map(
+            lambda s: jnp.zeros((n_blocks,) + s.shape, s.dtype), shapes
+        )
+
+    def decode_step(self, params, cache, tokens, pos_offset):
+        """Run one decode chunk (prompt at prefill, 1 token per generation
+        step) through the *sequential* stage stack with per-block KV caches:
+        returns ``(logits [b, chunk, vocab], new_cache)``.  Same math as the
+        full-context ``apply`` on the prefix (tests/test_generate.py); like
+        prediction, generation runs on the plain sequential executor — the
+        pipeline is a training-time schedule."""
+        h = self.embed(params["embed"], tokens, offset=pos_offset)
+        flat_blocks = jax.tree.map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), params["blocks"]
+        )
+
+        def body(x, block):
+            p, c = block
+            y, upd = self._block.apply(
+                {"params": p, "cache": c}, x, decode=True, mutable=["cache"]
+            )
+            return y, upd["cache"]
+
+        h, new_cache = lax.scan(body, h, (flat_blocks, cache))
+        return self.head(params["head"], h), new_cache

@@ -29,17 +29,62 @@ class _SelfAttention(nn.Module):
     heads: int
     seq_axis: Optional[str] = None
     causal: bool = False
+    max_len: Optional[int] = None  # KV-cache capacity for decode mode
 
     @nn.compact
-    def __call__(self, x, training: bool = False):
+    def __call__(self, x, training: bool = False, decode: bool = False):
         head_dim = self.dim // self.heads
         qkv = nn.DenseGeneral((3, self.heads, head_dim), name="qkv")(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        if self.seq_axis is not None:
+        if decode:
+            out = self._decode_attention(q, k, v)
+        elif self.seq_axis is not None:
             out = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
         else:
             out = attention(q, k, v, causal=self.causal)
         return nn.DenseGeneral(self.dim, axis=(-2, -1), name="proj")(out)
+
+    def _decode_attention(self, q, k, v):
+        """Chunked KV-cache attention for autoregressive decode: append this
+        chunk's K/V at the cache cursor, attend the chunk's queries over the
+        whole (padded) cache with position masking.  One code path serves
+        prefill (chunk = prompt) and generation (chunk = 1 token); padded
+        cache rows mask to exp(-inf) = 0 exactly, so the math matches the
+        full-context recompute path (tests/test_generate.py).  Cache
+        variables materialise on first use — run the prefill chunk with
+        ``mutable=["cache"]`` and no separate cache-init call is needed."""
+        if not self.causal or self.seq_axis is not None or self.max_len is None:
+            raise ValueError(
+                "KV-cache decode needs causal=True, seq_axis=None and "
+                "max_len set (generation runs on the single-device twin)"
+            )
+        b, chunk, h, hd = q.shape
+        cap = self.max_len
+        ck = self.variable("cache", "cached_key", jnp.zeros, (b, cap, h, hd), k.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros, (b, cap, h, hd), v.dtype)
+        idx = self.variable("cache", "cache_index",
+                            lambda: jnp.zeros((), jnp.int32))
+        i = idx.value
+        ck.value = lax.dynamic_update_slice(ck.value, k, (0, i, 0, 0))
+        cv.value = lax.dynamic_update_slice(cv.value, v, (0, i, 0, 0))
+        idx.value = i + chunk
+        # same layout/scale as ring.local_attention's reference math
+        qt = jnp.moveaxis(q, 1, 2)                 # [b, h, chunk, hd]
+        kt = jnp.moveaxis(ck.value, 1, 2)          # [b, h, cap, hd]
+        vt = jnp.moveaxis(cv.value, 1, 2)
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+        q_pos = (i + jnp.arange(chunk))[:, None]   # [chunk, 1]
+        key_pos = jnp.arange(cap)[None, :]         # [1, cap]
+        s = jnp.where(key_pos <= q_pos, s, -jnp.inf)
+        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vt)
+        # Decoding past max_len would silently clamp the cache write and
+        # attend over corrupted rows; the cursor is traced so we cannot
+        # raise — poison the output with NaN instead, which no plausible
+        # generation survives unnoticed.  (greedy_generate validates
+        # prompt+steps <= max_len statically and never hits this.)
+        out = jnp.where(i + chunk > cap, jnp.nan, out)
+        return jnp.moveaxis(out, 1, 2)
 
 
 class TransformerEncoderBlock(nn.Module):
@@ -49,11 +94,13 @@ class TransformerEncoderBlock(nn.Module):
     seq_axis: Optional[str] = None
     causal: bool = False
     dropout: float = 0.0
+    max_len: Optional[int] = None  # KV-cache capacity (decode mode only)
 
     @nn.compact
-    def __call__(self, x, training: bool = False):
+    def __call__(self, x, training: bool = False, decode: bool = False):
         h = nn.LayerNorm()(x)
-        h = _SelfAttention(self.dim, self.heads, self.seq_axis, self.causal)(h, training)
+        h = _SelfAttention(self.dim, self.heads, self.seq_axis, self.causal,
+                           self.max_len)(h, training, decode)
         if self.dropout > 0:
             h = nn.Dropout(self.dropout, deterministic=not training)(h)
         x = x + h
@@ -67,22 +114,26 @@ class TransformerEncoderBlock(nn.Module):
 
 
 def _encode_tokens(tokens, *, vocab_size, dim, heads, num_layers, max_len,
-                   seq_axis, causal, dropout, training):
+                   seq_axis, causal, dropout, training, decode=False,
+                   pos_offset=None):
     """Shared classifier/LM trunk: token + (block-offset) positional
     embeddings, encoder-block stack, final LayerNorm.  Must be called from
     inside an ``@nn.compact`` ``__call__`` — the modules it instantiates
     attach to the caller's scope (flat param names)."""
     tokens = tokens.astype(jnp.int32)
     block_len = tokens.shape[1]
-    offset = lax.axis_index(seq_axis) * block_len if seq_axis is not None else 0
+    if pos_offset is not None:
+        offset = pos_offset
+    else:
+        offset = lax.axis_index(seq_axis) * block_len if seq_axis is not None else 0
     positions = offset + jnp.arange(block_len)
     x = nn.Embed(vocab_size, dim, name="tok_embed")(tokens)
     x = x + nn.Embed(max_len, dim, name="pos_embed")(positions)[None]
     for i in range(num_layers):
         x = TransformerEncoderBlock(
             dim, heads, seq_axis=seq_axis, causal=causal,
-            dropout=dropout, name=f"block_{i}",
-        )(x, training)
+            dropout=dropout, max_len=max_len, name=f"block_{i}",
+        )(x, training, decode)
     return nn.LayerNorm()(x)
 
 
@@ -111,12 +162,21 @@ class TransformerLM(nn.Module):
     per_token_labels = True
 
     @nn.compact
-    def __call__(self, tokens, training: bool = False):
+    def __call__(self, tokens, training: bool = False, decode: bool = False):
+        pos_offset = None
+        if decode:
+            # decode chunks carry no absolute positions; a top-level cache
+            # cursor supplies them (prefill advances it by the prompt length,
+            # each generation step by 1)
+            pi = self.variable("cache", "pos_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            pos_offset = pi.value
+            pi.value = pos_offset + tokens.shape[1]
         x = _encode_tokens(
             tokens, vocab_size=self.vocab_size, dim=self.dim, heads=self.heads,
             num_layers=self.num_layers, max_len=self.max_len,
             seq_axis=self.seq_axis, causal=True, dropout=self.dropout,
-            training=training,
+            training=training, decode=decode, pos_offset=pos_offset,
         )
         return nn.Dense(self.vocab_size, name="lm_head")(x)
 

@@ -13,7 +13,8 @@ transformer LM on a synthetic next-token corpus five ways —
                                the same trainer — its params are the
                                initial center, as from_pretrained's would be)
 
-— then greedily generates from the trained model.  Runs on a faked
+— then greedily generates from the trained model with a carried KV cache
+(one jitted prefill + scan program; see distkeras_tpu/models/generate.py).  Runs on a faked
 8-device CPU mesh so it works anywhere (delete the two config lines on
 real chips).
 
@@ -47,11 +48,13 @@ def corpus(n=512, seed=0):
 
 
 def generate(model, ctx, steps=6):
-    ctx = np.asarray(ctx, np.int32)
-    for _ in range(steps):
-        nxt = np.argmax(np.asarray(model(ctx))[:, -1], -1)[:, None]
-        ctx = np.concatenate([ctx, nxt.astype(np.int32)], axis=1)
-    return ctx
+    """KV-cached greedy decode (models/generate.py): prefill + scanned
+    single-token steps in one jitted program, O(context) per step instead of
+    the O(context^2) full recompute — token-identical to it
+    (tests/test_generate.py)."""
+    from distkeras_tpu.models import greedy_generate
+
+    return greedy_generate(model, ctx, steps)
 
 
 def main():

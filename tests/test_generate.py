@@ -1,0 +1,136 @@
+"""KV-cached greedy decode: cached and recompute paths emit IDENTICAL tokens.
+
+The cache pads K/V to max_len and masks the unwritten tail to exp(-inf) = 0,
+so each step's logits equal the full-context recompute's last-position
+logits; greedy argmax must therefore match token for token.  Also pinned:
+the batched output shape (prompt included), cache-capacity validation, and
+the trainer-returned TrainedModel as the entry point.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.models import (
+    FlaxModel, StagedLM, TransformerLM, greedy_generate,
+)
+from distkeras_tpu.models.generate import greedy_generate_module
+
+VOCAB, SEQ = 23, 16
+
+
+def _corpus(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    start = rng.integers(0, VOCAB, size=(n, 1))
+    x = ((start + np.arange(SEQ)) % VOCAB).astype(np.int32)
+    return x, ((x + 1) % VOCAB).astype(np.int32)
+
+
+def _recompute(model, ctx, steps):
+    ctx = np.asarray(ctx, np.int32)
+    for _ in range(steps):
+        nxt = np.argmax(np.asarray(model(ctx))[:, -1], -1)[:, None]
+        ctx = np.concatenate([ctx, nxt.astype(np.int32)], axis=1)
+    return ctx
+
+
+def _train(model, **kw):
+    x, y = _corpus()
+    t = dk.DOWNPOUR(model, loss="token_crossentropy",
+                    metrics=("token_accuracy",),
+                    worker_optimizer=("adam", {"learning_rate": 2e-3}),
+                    num_workers=4, batch_size=16, num_epoch=3,
+                    communication_window=2, **kw)
+    return t.train(dk.from_numpy(x, y)), x
+
+
+def test_kv_cache_matches_recompute_transformer_lm():
+    trained, x = _train(FlaxModel(TransformerLM(
+        vocab_size=VOCAB, dim=32, heads=2, num_layers=2, max_len=64)))
+    prompt = x[:4, :8]
+    ref = _recompute(trained, prompt, 6)
+    out = greedy_generate(trained, prompt, 6)
+    assert out.shape == (4, 14) and out.dtype == np.int32
+    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(out[:, :8], prompt)  # prompt preserved
+
+
+def test_kv_cache_matches_recompute_staged_lm():
+    trained, x = _train(
+        StagedLM(vocab_size=VOCAB, dim=32, heads=2, num_stages=2,
+                 blocks_per_stage=1, max_len=64),
+        pipeline_stages=2,
+    )
+    prompt = x[:4, :8]
+    np.testing.assert_array_equal(
+        greedy_generate(trained, prompt, 6), _recompute(trained, prompt, 6)
+    )
+
+
+def test_untrained_module_path_and_validation():
+    """The module-level entry works on raw params, and capacity/shape errors
+    are loud (the cache is sized to max_len)."""
+    module = TransformerLM(vocab_size=VOCAB, dim=16, heads=2, num_layers=1,
+                           max_len=16)
+    prompt = np.zeros((2, 8), np.int32)
+    params = module.init(jax.random.PRNGKey(0), jnp.asarray(prompt))["params"]
+    out = greedy_generate_module(module, params, prompt, 8)
+    assert out.shape == (2, 16)
+    with pytest.raises(ValueError, match="max_len"):
+        greedy_generate_module(module, params, prompt, 9)
+    with pytest.raises(ValueError, match="batch"):
+        greedy_generate_module(module, params, prompt[0], 2)
+    np.testing.assert_array_equal(
+        greedy_generate_module(module, params, prompt, 0), prompt
+    )
+
+
+def test_generate_rejects_non_lm():
+    from distkeras_tpu.models import MLP
+
+    x = np.random.default_rng(0).normal(size=(64, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    t = dk.SingleTrainer(FlaxModel(MLP(features=(8,), num_classes=2)),
+                         worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                         batch_size=16, num_epoch=1)
+    trained = t.train(dk.from_numpy(x, np.eye(2, dtype=np.float32)[y]))
+    with pytest.raises(TypeError, match="decode"):
+        greedy_generate(trained, np.zeros((1, 4), np.int32), 2)
+
+
+def test_generate_rejects_classifier_by_name():
+    """TransformerClassifier has max_len but no decode support: the guard
+    must reject it with the named error, not a flax TypeError from deep
+    inside apply."""
+    from distkeras_tpu.models import TransformerClassifier
+    from distkeras_tpu.models.adapter import TrainedModel
+
+    module = TransformerClassifier(vocab_size=VOCAB, num_classes=2, dim=16,
+                                   heads=2, num_layers=1, max_len=16)
+    adapter = FlaxModel(module)
+    params, state = adapter.init(jax.random.PRNGKey(0),
+                                 np.zeros((2, 8), np.int32))
+    trained = TrainedModel(adapter, params, state)
+    with pytest.raises(TypeError, match="KV-cache decode"):
+        greedy_generate(trained, np.zeros((2, 8), np.int32), 2)
+
+
+def test_generate_program_is_cached_across_calls():
+    """Repeat calls with the same (module, steps, shapes) must reuse the
+    compiled decode program (serving-shaped: no per-request recompile)."""
+    from distkeras_tpu.models import generate as gen_mod
+
+    module = TransformerLM(vocab_size=VOCAB, dim=16, heads=2, num_layers=1,
+                           max_len=16)
+    prompt = np.zeros((2, 8), np.int32)
+    params = module.init(jax.random.PRNGKey(0), jnp.asarray(prompt))["params"]
+    out1 = greedy_generate_module(module, params, prompt, 4)
+    key = (id(module), 4)
+    assert key in gen_mod._DECODE_PROGRAMS
+    cached = gen_mod._DECODE_PROGRAMS[key][1]
+    misses_before = cached._cache_size()
+    out2 = greedy_generate_module(module, params, prompt, 4)
+    assert cached._cache_size() == misses_before  # no retrace
+    np.testing.assert_array_equal(out1, out2)
