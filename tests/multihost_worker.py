@@ -93,7 +93,7 @@ def main(coordinator: str, num_processes: int, process_id: int,
             num_workers=num_workers,
             fsdp=True,
         )
-    else:
+    else:  # "windowed" per-epoch dispatch, or "epochs" single-dispatch
         from distkeras_tpu.parallel.engine import WindowedEngine
 
         num_workers = 8
@@ -132,10 +132,18 @@ def main(coordinator: str, num_processes: int, process_id: int,
         )
         assert spans, "no center leaf is sharded across processes"
     xs_d, ys_d = engine.shard_batches(xs, ys)
-    losses = []
-    for _ in range(6):
-        state, stats = engine.run_epoch(state, xs_d, ys_d)
-        losses.append(float(np.mean(np.asarray(stats["loss"]))))
+    if engine_kind == "epochs":
+        # the bench harness's timed region — the multi-epoch single-dispatch
+        # run_epochs program with on-device reshuffle — compiled and run
+        # across processes (pod-day rehearsal: this is the program a real
+        # 8x-host sweep times)
+        state, stats = engine.run_epochs(state, xs_d, ys_d, 6, shuffle_seed=0)
+        losses = list(np.asarray(stats["loss"]).reshape(6, -1).mean(axis=1))
+    else:
+        losses = []
+        for _ in range(6):
+            state, stats = engine.run_epoch(state, xs_d, ys_d)
+            losses.append(float(np.mean(np.asarray(stats["loss"]))))
     assert losses[-1] < losses[0], losses
     assert int(np.asarray(state.center_rule["num_updates"])) == num_workers * 2 * 6
     print(f"process {process_id}: ok ({engine_kind}), "

@@ -1,0 +1,34 @@
+"""Pod-shaped dryrun rehearsal: the driver's multi-chip validation entry
+point at BEYOND-driver scale.
+
+The driver runs ``dryrun_multichip(8)``; the 8->64-chip north star
+(BASELINE.md) means the first larger-mesh attempt should not be the first
+time those layouts compile.  This runs the full dryrun — dp, dp x sp,
+dp x tp+fsdp, dp x pp, dp x ep, and the three-axis dp x pp x tp grid — over
+a 16-device virtual mesh in a subprocess (device count is fixed at backend
+init, so it cannot reuse pytest's 8-device process).  32 devices compiles
+too (verified manually, ~minutes on this 1-core host); 16 keeps the suite's
+wall-clock sane while still exercising a larger-than-driver grid.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_16_devices():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "__graft_entry__.py"), "16"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    assert "dryrun_multichip(16): ok" in out
+    assert "dp x sp" in out and "dp x tp" in out and "dp x pp (" in out
+    assert "dp x ep" in out
+    assert "dp x pp x tp (4 workers x 2 stages x 2 model): ok" in out

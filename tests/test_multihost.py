@@ -79,3 +79,14 @@ def test_two_process_pipeline_parallel():
     # the stages axis spans processes: ppermute activation hops and the
     # stage-sharded block params both cross the process boundary
     _run_processes(2, "pipeline")
+
+
+@pytest.mark.slow
+def test_eight_process_single_dispatch_epochs():
+    # pod-shaped rehearsal (VERDICT r3 "rehearse scale before scale
+    # exists"): EIGHT coordination-service processes, one device each, run
+    # the bench harness's actual timed program — the multi-epoch
+    # single-dispatch run_epochs scan with on-device reshuffle — so the
+    # first 8-host pod attempt is not the first time that code path
+    # executes.  Longer timeout: eight interpreters timeshare this host.
+    _run_processes(8, "epochs", timeout=540)
