@@ -1,29 +1,34 @@
 """Accuracy proof on the benchmark models — the "matched final accuracy"
-evidence BASELINE.json's north star demands (VERDICT r2 item 4).
+evidence BASELINE.json's north star demands (VERDICT r2 item 4, hardened
+per VERDICT r3 item 1).
 
-Trains the CIFAR-10 CNN (DOWNPOUR — the headline config) and the IMDB
-TextCNN (DynSGD) end to end through the DataFrame pipeline to asserted
-accuracy floors, printing one JSON line per model.
+Trains ALL SIX trainer families (SingleTrainer + the five async
+algorithms) on the CIFAR-10-CNN-shaped and IMDB-TextCNN-shaped tasks end
+to end through the DataFrame pipeline, printing one JSON line per
+(dataset, trainer) with each async trainer's accuracy gap to SingleTrainer
+on the same data — the benchmark-scale analogue of the README's digits
+experiment table.
 
 Datasets: real CIFAR-10 / IMDB when a local cache exists (keras.datasets;
 this environment has no network), otherwise **deterministic learnable
-proxies** of the same shape/scale:
+proxies** of the same shape/scale, deliberately hardened so SingleTrainer
+lands ~0.85-0.93 instead of saturating (a saturated task cannot detect an
+async-accuracy regression — round 3's artifact read 1.0 / 0.997):
 
 * ``cifar_proxy`` — 32x32x3 oriented sinusoidal gratings, one orientation
-  per class, random phase/frequency jitter + Gaussian pixel noise.  A CNN
-  must learn orientation-selective filters (exactly what its early conv
-  layers are for); a linear readout of raw pixels cannot average out the
-  random phases.
-* ``imdb_proxy`` — length-256 token sequences over the TextCNN's 20k vocab;
-  each class plants a handful of tokens from its own 100-token lexicon at
-  random positions in a stream of shared distractor tokens.  Max-pooled
-  n-gram detection — the thing a Kim-2014 text-CNN does — solves it;
-  counting raw token statistics barely beats chance because lexicon tokens
-  are rare and positions random.
+  per class, per-sample orientation jitter (Bayes ~0.93 at the default
+  5 degrees), random phase/frequency + heavy pixel noise.  A CNN must
+  learn orientation-selective filters; a linear pixel readout cannot.
+* ``imdb_proxy`` — length-256 token sequences over the TextCNN's 20k
+  vocab; each sequence plants 1+B(3,0.55) tokens from its class's
+  100-token lexicon and B(3,0.3) confusers from the other class's
+  (counting-oracle Bayes 0.914).  Max-pooled n-gram detection — the thing
+  a Kim-2014 text-CNN does — is the solution shape.
 
-Run:  python examples/accuracy.py [--epochs E] [--train N] [--cpu 8]
-Floors are asserted by tests/test_accuracy_proxies.py on the CPU mesh; the
-TPU-side artifact is ACCURACY_r03.json at the repo root.
+Run:  python examples/accuracy.py [--epochs E] [--workers N] [--cpu 8]
+Floors + gap bounds are asserted on the committed TPU artifact by
+tests/test_accuracy_proxies.py; the artifact is ACCURACY_r04.json at the
+repo root.
 """
 
 import argparse
@@ -36,12 +41,23 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 import numpy as np
 
 
-def make_cifar_proxy(n: int, seed: int = 0, num_classes: int = 10):
-    """Oriented-grating images [n, 32, 32, 3] in [0, 1], labels [n]."""
+def make_cifar_proxy(n: int, seed: int = 0, num_classes: int = 10,
+                     jitter_deg: float = 5.0, noise: float = 0.25):
+    """Oriented-grating images [n, 32, 32, 3] in [0, 1], labels [n].
+
+    Deliberately NON-saturating (VERDICT r3 weak #1: the round-3 variant
+    trained to 1.0, so "matched final accuracy" could not discriminate):
+    classes are 18-degree-apart orientations and each sample's orientation
+    is jittered by N(0, jitter_deg) — at 5 degrees the Bayes-optimal
+    orientation decoder itself tops out near 0.93
+    (P(|N(0,5)| < 9) = 0.928) — plus heavier pixel noise.  A trainer that
+    under-trains or mis-averages now shows up as a visible accuracy gap
+    instead of hiding at ceiling."""
     rng = np.random.default_rng(seed)
     y = rng.integers(0, num_classes, size=n)
     yy, xx = np.mgrid[0:32, 0:32].astype(np.float32)
-    theta = (y[:, None, None] * np.pi / num_classes).astype(np.float32)
+    jitter = rng.normal(0.0, np.deg2rad(jitter_deg), size=n).astype(np.float32)
+    theta = (y * np.pi / num_classes + jitter)[:, None, None].astype(np.float32)
     freq = rng.uniform(0.4, 0.7, size=(n, 1, 1)).astype(np.float32)
     phase = rng.uniform(0, 2 * np.pi, size=(n, 1, 1)).astype(np.float32)
     proj = xx[None] * np.cos(theta) + yy[None] * np.sin(theta)
@@ -49,25 +65,41 @@ def make_cifar_proxy(n: int, seed: int = 0, num_classes: int = 10):
     img = img[..., None].repeat(3, axis=-1)
     # per-channel colour jitter + pixel noise keep single pixels uninformative
     img *= rng.uniform(0.6, 1.0, size=(n, 1, 1, 3)).astype(np.float32)
-    img += rng.normal(0, 0.15, size=img.shape).astype(np.float32)
+    img += rng.normal(0, noise, size=img.shape).astype(np.float32)
     return np.clip(img, 0.0, 1.0).astype(np.float32), y.astype(np.int32)
 
 
 def make_imdb_proxy(n: int, seed: int = 0, seq_len: int = 256,
-                    vocab: int = 20000, lexicon: int = 100, planted: int = 6):
-    """Token sequences [n, seq_len] int32, binary labels [n]."""
+                    vocab: int = 20000, lexicon: int = 100):
+    """Token sequences [n, seq_len] int32, binary labels [n].
+
+    Hardened like the grating proxy: each sequence plants ``1 + B(3, 0.55)``
+    tokens from its OWN class lexicon and ``B(3, 0.3)`` confuser tokens from
+    the OTHER class's lexicon at random positions among shared distractors.
+    The Bayes decision (majority of lexicon hits, coin on ties) measures
+    0.914 — the counting oracle in tests/test_accuracy_proxies.py — so a
+    text-CNN that actually learns both lexicons lands high-80s/low-90s and
+    a mis-tuned trainer visibly below, instead of everything saturating at
+    0.99+ as in round 3."""
     rng = np.random.default_rng(seed)
     y = rng.integers(0, 2, size=n)
     # distractors avoid both lexica: tokens >= 1000
     x = rng.integers(1000, vocab, size=(n, seq_len))
-    base = 100 + y * lexicon  # class 0 -> [100, 200), class 1 -> [200, 300)
+    own_base = 100 + y * lexicon      # class 0 -> [100, 200), 1 -> [200, 300)
+    other_base = 100 + (1 - y) * lexicon
+    n_own = 1 + rng.binomial(3, 0.55, size=n)
+    n_other = rng.binomial(3, 0.3, size=n)
     for i in range(n):
-        pos = rng.choice(seq_len, size=planted, replace=False)
-        x[i, pos] = rng.integers(base[i], base[i] + lexicon, size=planted)
+        k = n_own[i] + n_other[i]
+        pos = rng.choice(seq_len, size=k, replace=False)
+        own_toks = rng.integers(own_base[i], own_base[i] + lexicon, size=n_own[i])
+        other_toks = rng.integers(other_base[i], other_base[i] + lexicon,
+                                  size=n_other[i])
+        x[i, pos] = np.concatenate([own_toks, other_toks])
     return x.astype(np.int32), y.astype(np.int32)
 
 
-def _train_eval(trainer_cls, model, train_xy, test_xy, *, num_workers,
+def _train_eval(trainer_cls, model, train_xy, test_xy, *,
                 trainer_kwargs, batch_size, epochs, num_classes):
     import distkeras_tpu as dk
 
@@ -78,7 +110,7 @@ def _train_eval(trainer_cls, model, train_xy, test_xy, *, num_workers,
     t = trainer_cls(model, loss="categorical_crossentropy",
                     features_col="features", label_col="label_oh",
                     batch_size=batch_size, num_epoch=epochs,
-                    num_workers=num_workers, seed=0, **trainer_kwargs)
+                    seed=0, **trainer_kwargs)
     trained = t.train(df)
     test_df = dk.from_numpy(x_te, y_te)
     pred = dk.ModelPredictor(trained, features_col="features").predict(test_df)
@@ -87,6 +119,33 @@ def _train_eval(trainer_cls, model, train_xy, test_xy, *, num_workers,
     acc = dk.AccuracyEvaluator(prediction_col="pidx",
                                label_col="label").evaluate(pred)
     return acc, t.get_training_time()
+
+
+def trainer_table(dk, num_workers: int, window: int, lr: float = 1e-3):
+    """All six trainer families with the LR discipline the digits experiment
+    table established (examples/experiments.py): sum-commit rules divide the
+    worker LR by N, ADAG rescales by window/N, the elastic pair keeps its
+    own rho/lr.  One shared communication window keeps the comparison about
+    the ALGORITHM, not the window."""
+    adam = ("adam", {"learning_rate": lr})
+    adam_sum = ("adam", {"learning_rate": lr / num_workers})
+    nw = {"num_workers": num_workers}
+    return [
+        ("single", dk.SingleTrainer, {"worker_optimizer": adam}),
+        ("downpour", dk.DOWNPOUR,
+         {"worker_optimizer": adam_sum, "communication_window": window, **nw}),
+        ("aeasgd", dk.AEASGD,
+         {"worker_optimizer": adam, "communication_window": window,
+          "rho": 1.0, "learning_rate": 0.05, **nw}),
+        ("eamsgd", dk.EAMSGD,
+         {"communication_window": window, "rho": 1.0, "learning_rate": 0.05,
+          "momentum": 0.9, **nw}),
+        ("adag", dk.ADAG,
+         {"worker_optimizer": ("adam", {"learning_rate": lr * window / num_workers}),
+          "communication_window": window, **nw}),
+        ("dynsgd", dk.DynSGD,
+         {"worker_optimizer": adam_sum, "communication_window": window, **nw}),
+    ]
 
 
 def try_real_cifar10():
@@ -120,10 +179,16 @@ def try_real_imdb(seq_len=256, vocab=20000):
         return None
 
 
-def run_accuracy(num_workers=None, epochs=4, n_train=8192, n_test=2048,
+def run_accuracy(num_workers=None, epochs=6, n_train=8192, n_test=2048,
                  batch_size=64, include=("cifar", "imdb"), window=None,
-                 lr=1e-3):
-    """Returns a list of result dicts (one per model)."""
+                 lr=1e-3, trainers=None):
+    """Returns a list of result dicts — one per (dataset, trainer).
+
+    VERDICT r3 item 1: ALL SIX trainer families run on both benchmark-model
+    proxies, each row carrying its gap to SingleTrainer on the same data —
+    the benchmark-scale analogue of the digits experiment table, on tasks
+    hard enough (see the proxy docstrings) that the gaps mean something.
+    """
     import jax
 
     import distkeras_tpu as dk
@@ -135,8 +200,12 @@ def run_accuracy(num_workers=None, epochs=4, n_train=8192, n_test=2048,
         # padding to a window multiple doesn't multiply the work on small runs.
         steps_per_epoch = max(1, n_train // (num_workers * batch_size))
         window = max(1, min(4, steps_per_epoch))
+    table = trainer_table(dk, num_workers, window, lr)
+    if trainers:
+        table = [row for row in table if row[0] in trainers]
     results = []
 
+    datasets = []
     if "cifar" in include:
         real = try_real_cifar10()
         if real is not None:
@@ -145,27 +214,8 @@ def run_accuracy(num_workers=None, epochs=4, n_train=8192, n_test=2048,
             train = make_cifar_proxy(n_train, seed=0)
             test = make_cifar_proxy(n_test, seed=1)
             dataset = "cifar_proxy"
-        acc, seconds = _train_eval(
-            dk.DOWNPOUR, FlaxModel(CIFARCNN()), train, test,
-            num_workers=num_workers,
-            trainer_kwargs={
-                # DOWNPOUR's commit adds the SUM of worker deltas to the
-                # center, so the worker lr divides by the worker count to keep
-                # the center step at ``lr`` (the mis-tuning VERDICT r2 item 4
-                # flagged on the digits table).
-                "worker_optimizer": ("adam", {"learning_rate": lr / num_workers}),
-                "communication_window": window,
-                # full unroll of the per-step scan: math-invariant, and on the
-                # CPU test mesh it sidesteps XLA:CPU's pathological compile
-                # times for conv loops (see WindowedEngine._finish_init)
-                "unroll": True,
-            },
-            batch_size=batch_size, epochs=epochs, num_classes=10)
-        results.append({"metric": f"{dataset}_cnn_downpour_accuracy",
-                        "value": round(acc, 4), "unit": "test accuracy",
-                        "dataset": dataset, "epochs": epochs,
-                        "train_seconds": round(seconds, 1)})
-
+        datasets.append((dataset, "cnn", train, test, 10,
+                         lambda: FlaxModel(CIFARCNN())))
     if "imdb" in include:
         real = try_real_imdb()
         if real is not None:
@@ -174,28 +224,36 @@ def run_accuracy(num_workers=None, epochs=4, n_train=8192, n_test=2048,
             train = make_imdb_proxy(n_train, seed=0)
             test = make_imdb_proxy(n_test, seed=1)
             dataset = "imdb_proxy"
-        acc, seconds = _train_eval(
-            dk.DynSGD, FlaxModel(TextCNN(vocab_size=20000, num_classes=2)),
-            train, test, num_workers=num_workers,
-            trainer_kwargs={
-                # DynSGD divides each delta by (staleness+1) itself, but with
-                # uniform windows every worker has staleness 0 — same sum-of-
-                # deltas scaling as DOWNPOUR, same lr correction.
-                "worker_optimizer": ("adam", {"learning_rate": lr / num_workers}),
-                "communication_window": window,
-                "unroll": True,
-            },
-            batch_size=batch_size, epochs=epochs, num_classes=2)
-        results.append({"metric": f"{dataset}_textcnn_dynsgd_accuracy",
-                        "value": round(acc, 4), "unit": "test accuracy",
-                        "dataset": dataset, "epochs": epochs,
-                        "train_seconds": round(seconds, 1)})
+        datasets.append((dataset, "textcnn", train, test, 2,
+                         lambda: FlaxModel(TextCNN(vocab_size=20000,
+                                                   num_classes=2))))
+
+    for dataset, model_tag, train, test, classes, fresh_model in datasets:
+        single_acc = None
+        for name, cls, kw in table:
+            acc, seconds = _train_eval(
+                cls, fresh_model(), train, test,
+                # full unroll of the per-step scan: math-invariant, and on
+                # the CPU test mesh it sidesteps XLA:CPU's pathological
+                # compile times for conv loops (WindowedEngine._finish_init)
+                trainer_kwargs={**kw, "unroll": True},
+                batch_size=batch_size, epochs=epochs, num_classes=classes)
+            if name == "single":
+                single_acc = acc
+            row = {"metric": f"{dataset}_{model_tag}_{name}_accuracy",
+                   "value": round(acc, 4), "unit": "test accuracy",
+                   "trainer": name, "dataset": dataset, "epochs": epochs,
+                   "num_workers": 1 if name == "single" else num_workers,
+                   "train_seconds": round(seconds, 1)}
+            if single_acc is not None and name != "single":
+                row["gap_to_single"] = round(single_acc - acc, 4)
+            results.append(row)
     return results
 
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--epochs", type=int, default=4)
+    parser.add_argument("--epochs", type=int, default=6)
     parser.add_argument("--train", type=int, default=8192)
     parser.add_argument("--test", type=int, default=2048)
     parser.add_argument("--batch-size", type=int, default=64)
@@ -203,6 +261,9 @@ def main():
     parser.add_argument("--window", type=int, default=None)
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--include", type=str, default="cifar,imdb")
+    parser.add_argument("--trainers", type=str, default="",
+                        help="comma list (single,downpour,aeasgd,eamsgd,"
+                        "adag,dynsgd); empty = all six")
     parser.add_argument("--cpu", type=int, default=0, metavar="N",
                         help="force an N-device CPU mesh (offline / no TPU)")
     args = parser.parse_args()
@@ -217,10 +278,12 @@ def main():
     unknown = set(include) - {"cifar", "imdb"}
     if not include or unknown:
         parser.error(f"--include takes a comma list of cifar,imdb (got {args.include!r})")
+    trainers = tuple(s.strip() for s in args.trainers.split(",") if s.strip()) or None
     for result in run_accuracy(args.workers, args.epochs, args.train,
                                args.test, args.batch_size,
                                include=include,
-                               window=args.window, lr=args.lr):
+                               window=args.window, lr=args.lr,
+                               trainers=trainers):
         result["backend"] = jax.default_backend()
         print(json.dumps(result))
 

@@ -1,10 +1,14 @@
-"""Accuracy-proof harness (examples/accuracy.py, VERDICT r2 item 4).
+"""Accuracy-proof harness (examples/accuracy.py; VERDICT r2 item 4,
+hardened per VERDICT r3 item 1).
 
 The real floors are enforced on the committed TPU artifact
-(ACCURACY_r03.json — CIFAR CNN under DOWNPOUR, IMDB TextCNN under DynSGD):
-this 1-core CI box cannot train CIFAR-scale convs in test time, so CI
-asserts (a) the proxy datasets are deterministic and class-informative, and
-(b) the committed artifact meets the floors the script claims.
+(ACCURACY_r04.json — ALL SIX trainer families on both benchmark-model
+proxies): this 1-core CI box cannot train CIFAR-scale convs in test time,
+so CI asserts (a) the proxy datasets are deterministic, class-informative,
+and GENUINELY HARD (their Bayes-style oracles land mid-80s/low-90s, so a
+saturated artifact would mean the task regressed to trivial), and (b) the
+committed artifact is non-saturated, complete, and within the async-gap
+bound — the discriminative "matched final accuracy" contract.
 """
 
 import json
@@ -19,7 +23,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 from accuracy import make_cifar_proxy, make_imdb_proxy
 
 ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                        "ACCURACY_r03.json")
+                        "ACCURACY_r04.json")
 
 
 def test_cifar_proxy_deterministic_and_shaped():
@@ -40,53 +44,75 @@ def test_imdb_proxy_deterministic_and_shaped():
     assert x1.min() >= 100 and x1.max() < 20000
 
 
-def test_cifar_proxy_is_orientation_separable():
-    """The class signal is real and pixel-level-nonlinear: per-class mean
-    images of the oriented gratings are near-uniform (phase averages out),
-    while an oriented-energy statistic separates classes."""
+def test_cifar_proxy_is_orientation_separable_but_not_trivially():
+    """The class signal is real and pixel-level-nonlinear — and the
+    orientation jitter means even an oriented-energy oracle cannot
+    saturate: the proxy has genuine headroom below 1.0."""
     x, y = make_cifar_proxy(2048, seed=0, num_classes=2)
     gray = x.mean(-1)
     # phase randomisation: class-mean images carry almost no signal
     m0, m1 = gray[y == 0].mean(0), gray[y == 1].mean(0)
     assert np.abs(m0 - m1).max() < 0.15
-    # oriented gradient energy separates the two orientations cleanly
+    # oriented gradient energy still separates the two orientations (the
+    # task is learnable), but jitter + noise keep it off ceiling
     gx = np.abs(np.diff(gray, axis=2)).mean((1, 2))
     gy = np.abs(np.diff(gray, axis=1)).mean((1, 2))
     stat = gx - gy  # class 0 (theta=0): vertical stripes -> gx >> gy
     acc = max(((stat > 0) == (y == 0)).mean(), ((stat > 0) == (y == 1)).mean())
-    assert acc > 0.95
+    assert acc > 0.85
 
 
-def test_imdb_proxy_lexicons_disjoint_and_rare():
-    x, y = make_imdb_proxy(256, seed=0)
-    lex0 = (x >= 100) & (x < 200)
-    lex1 = (x >= 200) & (x < 300)
-    # planted tokens only from the class's own lexicon
-    assert lex1[y == 0].sum() == 0 and lex0[y == 1].sum() == 0
-    # and they are rare (6 of 256): token-frequency shortcuts stay weak
-    assert lex0[y == 0].sum(axis=1).max() <= 8
+def test_imdb_proxy_counting_oracle_is_non_saturating():
+    """The Bayes-style decision (majority of own-vs-other lexicon hits,
+    ties split) must land near its designed 0.914 — hard enough that a
+    trained model cannot saturate, easy enough that it must beat 0.8."""
+    x, y = make_imdb_proxy(20000, seed=0)
+    lex0 = ((x >= 100) & (x < 200)).sum(axis=1)
+    lex1 = ((x >= 200) & (x < 300)).sum(axis=1)
+    own = np.where(y == 0, lex0, lex1)
+    other = np.where(y == 0, lex1, lex0)
+    oracle = (own > other).mean() + 0.5 * (own == other).mean()
+    assert 0.88 < oracle < 0.94, oracle
+    # confusers are REAL: other-lexicon tokens appear in a sizable minority
+    assert 0.15 < (other > 0).mean() < 0.75
+    # every sequence plants at least one own-lexicon token
+    assert own.min() >= 1
 
 
-FLOORS = {
-    "cifar_proxy_cnn_downpour_accuracy": 0.90,
-    "imdb_proxy_textcnn_dynsgd_accuracy": 0.90,
-    # real datasets, when a keras cache exists on the producing machine
-    "cifar10_cnn_downpour_accuracy": 0.60,
-    "imdb_textcnn_dynsgd_accuracy": 0.85,
-}
+TRAINERS = ("single", "downpour", "aeasgd", "eamsgd", "adag", "dynsgd")
+# SingleTrainer must sit in the discriminative band: high enough to prove
+# learning, below saturation so async gaps are measurable.
+SINGLE_BAND = (0.78, 0.97)
+MAX_GAP_TO_SINGLE = 0.025  # VERDICT r3 item 1's bound, in accuracy points
 
 
-def test_accuracy_artifact_meets_floors():
-    """The committed TPU artifact proves the async trainers actually learn
-    the benchmark-shaped tasks (measured 1.0 / 0.9971 on 2026-07-31)."""
+def test_accuracy_artifact_six_trainers_nonsaturated_and_gap_bounded():
+    """The committed TPU artifact: every trainer family, both datasets,
+    SingleTrainer off ceiling, every async trainer within 2.5 points."""
     with open(ARTIFACT) as fh:
         artifact = json.load(fh)
-    results = {r["metric"]: r for r in artifact["results"]}
-    assert any(m.startswith("cifar") for m in results), results.keys()
-    assert any(m.startswith("imdb") for m in results), results.keys()
-    for metric, r in results.items():
-        assert metric in FLOORS, f"no floor declared for {metric}"
-        assert r["value"] >= FLOORS[metric], (
-            f"{metric}: {r['value']} below floor {FLOORS[metric]}"
+    rows = {r["metric"]: r for r in artifact["results"]}
+    datasets = {r["dataset"] for r in rows.values()}
+    assert any(d.startswith("cifar") for d in datasets), datasets
+    assert any(d.startswith("imdb") for d in datasets), datasets
+    for dataset in datasets:
+        by_trainer = {r["trainer"]: r for r in rows.values()
+                      if r["dataset"] == dataset}
+        missing = [t for t in TRAINERS if t not in by_trainer]
+        assert not missing, f"{dataset}: no rows for {missing}"
+        single = by_trainer["single"]["value"]
+        assert SINGLE_BAND[0] <= single <= SINGLE_BAND[1], (
+            f"{dataset}: SingleTrainer {single} outside the discriminative "
+            f"band {SINGLE_BAND} — saturated artifacts can't detect "
+            "async-accuracy regressions"
         )
-        assert r["backend"] == "tpu"
+        for t in TRAINERS[1:]:
+            row = by_trainer[t]
+            gap = single - row["value"]
+            assert gap <= MAX_GAP_TO_SINGLE, (
+                f"{dataset}/{t}: accuracy {row['value']} is "
+                f"{gap:.4f} below SingleTrainer's {single}"
+            )
+            assert row.get("gap_to_single") is not None
+        for row in by_trainer.values():
+            assert row["backend"] == "tpu"
