@@ -90,6 +90,18 @@ class WindowedEngine:
     # the stage matmuls from the state's model-axis shardings while the
     # ppermute pipeline and commit psums stay hand-written.
     _manual_axes: frozenset = frozenset()
+    # seq-axis ZeRO center sharding — off unless __init__ enables it, and
+    # class-level defaults keep subclasses with their own __init__ (GSPMD,
+    # pipeline) on the replicated-center path.  ``fsdp`` is the public
+    # "center is sharded" flag every engine exposes (GSPMD sets its own);
+    # ``_fsdp_seq`` is the internal discriminator the SHARED code paths
+    # (_window_fn/_step_fn/_center_in_specs) gate on, because GSPMD's fsdp
+    # is partitioner-placed over the workers axis and must NOT trigger the
+    # hand-placed seq-axis gathers.
+    _fsdp_seq: bool = False
+    _center_fsdp_dims = None
+    _fsdp_regather = None
+    fsdp: bool = False
 
     def __init__(
         self,
@@ -105,12 +117,30 @@ class WindowedEngine:
         sync_model_state: bool = True,
         mesh=None,
         seq_shards: int = 1,
+        fsdp: bool = False,
         remat: bool = False,
         unroll=1,
     ):
         self.adapter = adapter
         self.rule = rule
         self.seq_shards = int(seq_shards)
+        # ZeRO-style center sharding over the SEQ axis (fsdp x sp in one
+        # mesh): on the (workers, seq) grid the center variable is otherwise
+        # replicated seq_shards x — pure redundancy, since the seq axis
+        # exists for activations.  With fsdp=True each seq-row device stores
+        # 1/seq_shards of every evenly-splitting center leaf; the window
+        # commit all-gathers the shards at use and re-slices after (the
+        # hand-placed-collective form of the GSPMD engine's gather-at-use
+        # fsdp — trajectory-identical to the replicated layout).  fsdp
+        # without sequence parallelism is the GSPMD engine's job.
+        self._fsdp_seq = bool(fsdp)
+        self.fsdp = self._fsdp_seq
+        if self._fsdp_seq and self.seq_shards <= 1:
+            raise ValueError(
+                "fsdp=True on WindowedEngine shards the center over the seq "
+                "axis and needs seq_shards>1; for fsdp without sequence "
+                "parallelism use the GSPMD engine (trainers route it there)"
+            )
         n_devices = jax.device_count() if mesh is None else mesh.devices.size
         if self.seq_shards > 1:
             # combined data x sequence parallelism: 2-D mesh, worker state on
@@ -191,6 +221,7 @@ class WindowedEngine:
             )(sample)
         else:
             params, model_state = self.adapter.init(rng, sample_input)
+        self._record_fsdp_dims(params)
 
         def _build(params, model_state):
             return self._assemble_state(rng, params, model_state)
@@ -199,11 +230,90 @@ class WindowedEngine:
         with self.mesh:
             return jax.jit(_build, out_shardings=shardings)(params, model_state)
 
+    # ---------------------------------------------- fsdp (seq-axis ZeRO center)
+    def _record_fsdp_dims(self, params):
+        """Choose, per center leaf, which dim the seq axis shards: the
+        largest dim that splits evenly with >=2 rows per shard, or -1 to
+        stay replicated (a tree of ints — ``None`` is not a pytree leaf).
+        Recorded from the real param shapes at ``init_state`` /
+        ``state_from_center``; every later spec/gather/slice reads this one
+        table so block-shape recomputation can never pick a different dim."""
+        if not self._fsdp_seq:
+            return
+
+        def dim_for(x):
+            shape = np.shape(x)
+            free = [d for d, s in enumerate(shape)
+                    if s % self.seq_shards == 0 and s >= 2 * self.seq_shards]
+            return max(free, key=lambda d: shape[d]) if free else -1
+
+        self._center_fsdp_dims = jax.tree.map(dim_for, params)
+        if all(d < 0 for d in jax.tree.leaves(self._center_fsdp_dims)):
+            # fsdp=True with nothing shardable would silently store the
+            # full center replicated — exactly the HBM redundancy the flag
+            # exists to remove.  Say so instead of OOMing mysteriously.
+            import warnings
+
+            warnings.warn(
+                f"fsdp=True: no center leaf has a dim divisible by "
+                f"seq_shards={self.seq_shards} (with >=2 rows per shard); "
+                "the center stays fully replicated", stacklevel=3,
+            )
+
+    def _fsdp_leaf_spec(self, d) -> P:
+        return P() if d < 0 else P(*([None] * d), SEQ_AXIS)
+
+    def _fsdp_center_specs(self):
+        if self._center_fsdp_dims is None:
+            raise RuntimeError(
+                "fsdp=True center placement is recorded from the param "
+                "shapes; build the state via init_state/state_from_center "
+                "before running epochs"
+            )
+        return jax.tree.map(self._fsdp_leaf_spec, self._center_fsdp_dims)
+
+    def _fsdp_gather(self, tree):
+        """Inside shard_map: materialise the full center from its seq-axis
+        shards (gather-at-use, the window-commit analogue of ZeRO-3's
+        pre-layer all-gather)."""
+        if not self._fsdp_seq:
+            return tree
+        return jax.tree.map(
+            lambda d, x: x if d < 0 else lax.all_gather(
+                x, SEQ_AXIS, axis=d, tiled=True),
+            self._center_fsdp_dims, tree,
+        )
+
+    def _fsdp_shard(self, tree):
+        """Inside shard_map: keep only this seq-row's block of the updated
+        center (the commit math ran full-size; storage goes back to
+        1/seq_shards)."""
+        if not self._fsdp_seq:
+            return tree
+        idx = lax.axis_index(SEQ_AXIS)
+
+        def one(d, x):
+            if d < 0:
+                return x
+            block = x.shape[d] // self.seq_shards
+            return lax.dynamic_slice_in_dim(x, idx * block, block, axis=d)
+
+        return jax.tree.map(one, self._center_fsdp_dims, tree)
+
     def _constrain_center(self, tree):
         """Placement hook for center leaves inside state assembly — identity
-        here (center is replicated by the shard_map specs); the GSPMD engine
-        overrides it with TP/fsdp sharding constraints."""
-        return tree
+        unless seq-axis fsdp is on (then each leaf pins to its recorded
+        seq-shard layout); the GSPMD engine overrides it with TP/fsdp
+        sharding constraints."""
+        if not self._fsdp_seq:
+            return tree
+        from jax.sharding import NamedSharding
+
+        return jax.tree.map(
+            lambda d, x: lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, self._fsdp_leaf_spec(d))),
+            self._center_fsdp_dims, tree,
+        )
 
     def _constrain_worker(self, tree):
         """Placement hook for per-worker ``[num_workers, ...]`` leaves —
@@ -254,6 +364,8 @@ class WindowedEngine:
         # under their constrained shardings in one transfer (an eager
         # asarray here would first materialise the full center replicated
         # on one device — the spike fsdp exists to avoid)
+        self._record_fsdp_dims(center_params)
+
         def _build(params, ms):
             st = self._assemble_state(rng, params, ms)
             return st.replace(
@@ -271,8 +383,16 @@ class WindowedEngine:
         overrides this with per-leaf shardings (stage-stacked leaves shard
         over the stages axis too)."""
         del build_fn, params, model_state
+        center = self._rep
+        if self._fsdp_seq:
+            from jax.sharding import NamedSharding
+
+            center = jax.tree.map(
+                lambda d: NamedSharding(self.mesh, self._fsdp_leaf_spec(d)),
+                self._center_fsdp_dims,
+            )
         return TrainState(
-            center_params=self._rep,
+            center_params=center,
             center_rule=self._rep,
             local_params=self._shard,
             opt_state=self._shard,
@@ -341,8 +461,11 @@ class WindowedEngine:
 
     def _center_in_specs(self):
         """shard_map specs (or per-leaf spec trees) for
-        ``(center_params, center_rule)`` — replicated here; the pipeline
-        engine shards stage-stacked center leaves over the stages axis."""
+        ``(center_params, center_rule)`` — replicated here (per-leaf
+        seq-shard specs under fsdp); the pipeline engine shards
+        stage-stacked center leaves over the stages axis."""
+        if self._fsdp_seq:
+            return self._fsdp_center_specs(), P()
         return P(), P()
 
     def _make_ctx(self, mask, steps_in_window) -> CommitCtx:
@@ -402,10 +525,16 @@ class WindowedEngine:
                 wdata, unroll=self.unroll,
             )
             if do_commit:
+                # seq-axis fsdp: the commit is the one place the full center
+                # is needed — gather the shards at use, run the rule's math
+                # unchanged (so trajectories match the replicated layout
+                # exactly), keep only this row's block after
+                center_params = self._fsdp_gather(center_params)
                 ctx = self._make_ctx(True, float(window))
                 res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
                 local_params, center_params = res.local_params, res.center_params
                 rule_local, center_rule = res.local_state, res.center_state
+                center_params = self._fsdp_shard(center_params)
                 model_state = self._sync_model_state(ctx, model_state)
             # Window stats stay worker-local here; one psum at the end of the
             # epoch reduces them (a per-window collective in the scan body
@@ -576,9 +705,13 @@ class WindowedEngine:
             mask = (t + 1) % my_window == 0
             ctx = self._make_ctx(mask, 1.0)
             ctx = ctx._replace(steps_in_window=since.astype(jnp.float32))
+            # seq-axis fsdp: gather-at-use around the masked commit (a
+            # masked-off step updates nothing, so gather->slice is identity)
+            center_params = self._fsdp_gather(center_params)
             res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
             local_params, center_params = res.local_params, res.center_params
             rule_local, center_rule = res.local_state, res.center_state
+            center_params = self._fsdp_shard(center_params)
             model_state = self._sync_model_state(ctx, model_state)
             since = jnp.where(mask, 0, since)
             local = (local_params, opt_state, model_state, rule_local, rng)
@@ -838,8 +971,16 @@ class WindowedEngine:
 
     def gather_center(self, state: TrainState):
         """Center params as host-gatherable (replicated) arrays.  Already
-        replicated in this engine; the GSPMD engine re-replicates its
-        model-axis-sharded leaves here."""
+        replicated in this engine unless seq-axis fsdp sharded them; the
+        GSPMD engine re-replicates its model-axis-sharded leaves here."""
+        if self._fsdp_seq:
+            # one cached re-replication program — a fresh lambda per call
+            # would miss jit's function-object cache and re-trace every
+            # checkpoint save (the per-call-closure trap, generate.py doc)
+            if self._fsdp_regather is None:
+                self._fsdp_regather = jax.jit(lambda t: t, out_shardings=self._rep)
+            with self.mesh:
+                return self._fsdp_regather(state.center_params)
         return state.center_params
 
     # --------------------------------------------------------------- sharding

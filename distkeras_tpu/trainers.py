@@ -292,11 +292,12 @@ class Trainer:
                 remat=self.remat,
                 unroll=self.unroll,
             )
-        elif self.tp_shards > 1 or self.fsdp:
+        elif self.tp_shards > 1 or (self.fsdp and self.seq_shards == 1):
             if self.seq_shards > 1:
                 raise ValueError(
-                    "tp_shards>1/fsdp (GSPMD engine) is incompatible with "
-                    "seq_shards>1 (ring attention needs the shard_map engine)"
+                    "tp_shards>1 (GSPMD engine) is incompatible with "
+                    "seq_shards>1 (ring attention needs the shard_map "
+                    "engine); fsdp + seq_shards IS supported — drop tp_shards"
                 )
             from distkeras_tpu.parallel.gspmd import GSPMDEngine
 
@@ -326,6 +327,9 @@ class Trainer:
                 compute_dtype=self.compute_dtype,
                 commit_schedule=commit_schedule,
                 seq_shards=self.seq_shards,
+                # fsdp x sp: seq-axis ZeRO center sharding in the shard_map
+                # engine (fsdp alone routed to the GSPMD engine above)
+                fsdp=self.fsdp and self.seq_shards > 1,
                 remat=self.remat,
                 unroll=self.unroll,
             )

@@ -1,0 +1,96 @@
+"""Pipelined StagedLM decode: the stage-ring executor emits IDENTICAL tokens
+to the single-device sequential executor (VERDICT r4 weak #5 / item 7).
+
+The contract: per-device residency is ONE stage's blocks + ONE stage's KV
+cache (in_specs shard both over the stages axis), yet the ring schedule —
+adopt-gated stage applies + ppermute neighbour hops — computes exactly the
+sequential stage stack, so greedy argmax must match token for token.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import StagedLM
+from distkeras_tpu.models.generate import (
+    greedy_generate_staged,
+    greedy_generate_staged_pipelined,
+)
+
+VOCAB = 23
+
+
+def _staged(num_stages=2, per_stage=2):
+    return StagedLM(vocab_size=VOCAB, dim=32, heads=2, num_stages=num_stages,
+                    blocks_per_stage=per_stage, max_len=64)
+
+
+def _params(staged, seed=0):
+    x = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % VOCAB
+    params, _ = staged.init(jax.random.PRNGKey(seed), x)
+    return params
+
+
+@pytest.mark.parametrize("num_stages,per_stage", [(2, 2), (4, 1)])
+def test_pipelined_decode_matches_sequential(num_stages, per_stage):
+    staged = _staged(num_stages, per_stage)
+    params = _params(staged)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, VOCAB, size=(4, 8)).astype(np.int32)
+    seq = greedy_generate_staged(staged, params, prompt, 6)
+    pp = greedy_generate_staged_pipelined(staged, params, prompt, 6)
+    assert pp.shape == (4, 14) and pp.dtype == np.int32
+    np.testing.assert_array_equal(pp, seq)
+    np.testing.assert_array_equal(pp[:, :8], prompt)
+
+
+def test_pipelined_decode_single_step_and_zero():
+    staged = _staged()
+    params = _params(staged, seed=1)
+    prompt = np.arange(3 * 5, dtype=np.int32).reshape(3, 5) % VOCAB
+    np.testing.assert_array_equal(
+        greedy_generate_staged_pipelined(staged, params, prompt, 0), prompt)
+    seq = greedy_generate_staged(staged, params, prompt, 1)
+    pp = greedy_generate_staged_pipelined(staged, params, prompt, 1)
+    np.testing.assert_array_equal(pp, seq)
+
+
+def test_pipelined_decode_rejects_too_few_devices():
+    staged = _staged(num_stages=2)
+    params = _params(staged)
+    prompt = np.zeros((1, 4), np.int32)
+    with pytest.raises(ValueError, match="devices"):
+        greedy_generate_staged_pipelined(staged, params, prompt, 2,
+                                         devices=jax.devices()[:1])
+
+
+def test_pipelined_entry_point_kwarg():
+    """greedy_generate(pipelined=True) routes a trainer-returned StagedLM
+    through the mesh executor; non-staged models reject the kwarg."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import FlaxModel, TransformerLM
+
+    staged = _staged()
+    x = (np.arange(64 * 16).reshape(64, 16) % VOCAB).astype(np.int32)
+    y = ((x + 1) % VOCAB).astype(np.int32)
+    t = dk.SingleTrainer(staged, loss="token_crossentropy",
+                         metrics=("token_accuracy",),
+                         worker_optimizer=("adam", {"learning_rate": 2e-3}),
+                         batch_size=16, num_epoch=1)
+    trained = t.train(dk.from_numpy(x, y))
+    prompt = x[:2, :6]
+    from distkeras_tpu.models.generate import greedy_generate
+
+    seq = greedy_generate(trained, prompt, 4)
+    pp = greedy_generate(trained, prompt, 4, pipelined=True)
+    np.testing.assert_array_equal(pp, seq)
+
+    lm = FlaxModel(TransformerLM(vocab_size=VOCAB, dim=16, heads=2,
+                                 num_layers=1, max_len=32))
+    t2 = dk.SingleTrainer(lm, loss="token_crossentropy",
+                          metrics=("token_accuracy",),
+                          worker_optimizer=("adam", {"learning_rate": 2e-3}),
+                          batch_size=16, num_epoch=1)
+    trained2 = t2.train(dk.from_numpy(x[:, :16], y[:, :16]))
+    with pytest.raises(TypeError, match="pipelined"):
+        greedy_generate(trained2, prompt, 2, pipelined=True)
