@@ -681,6 +681,25 @@ def run_config(config: str, n_windows: int = 8, reps: int = None, k: int = 5,
     spread_pct = round(100.0 * (max(vals) - min(vals)) / sps_per_chip, 1)
 
     peak = _peak_flops(jax.devices()[0].device_kind)
+    if peak:
+        # Physics guard: a faulted axon device can start resolving buffers
+        # instantly WITHOUT raising (observed 2026-07-31: resnet20 "measured"
+        # 38e9 samples/s/chip, implied MFU 47,594, before the fault finally
+        # surfaced as UNAVAILABLE two configs later).  Throughput above the
+        # chip's peak-FLOPs roofline is not a measurement — refuse to print
+        # it; the one-line contract turns this into an error verdict, and
+        # --write-baseline refuses the poisoned pin.
+        implied_mfu = sps_per_chip * analytic_train_flops_per_sample(config) / peak
+        if implied_mfu > 1.2:
+            # drop this run's executables before the caller moves on: a live
+            # stale executable degrades the NEXT config's steady-state
+            # throughput (the round-2 lesson, module docstring)
+            engine.clear_program_cache()
+            gc.collect()
+            raise RuntimeError(
+                f"implied MFU {implied_mfu:.1f} exceeds the hardware roofline "
+                "— device returned without executing (tunnel/device fault?)"
+            )
     # Cross-check compile only after the timed region (see _xla_step_flops).
     xla_step = _xla_step_flops(engine, state, xs, ys) if peak else None
     gc.collect()
@@ -702,12 +721,13 @@ def _vs_baseline_fields(config: str, sps_per_chip: float) -> dict:
     """Pin comparison, valid only same-protocol: a pin taken under a
     different timed-region definition would make vs_baseline a unit error,
     so it fails LOUDLY (null + pin_error) instead of printing green."""
-    pins, pin_protocol = {}, None
+    pins, pin_protocol, pin_device = {}, None, None
     if os.path.exists(BASELINE_FILE):
         try:
             data = json.load(open(BASELINE_FILE))
             pins = data.get("configs", {})
             pin_protocol = data.get("protocol")
+            pin_device = data.get("device_kind")
         except Exception:
             pins = {}
     if config not in pins:
@@ -719,6 +739,19 @@ def _vs_baseline_fields(config: str, sps_per_chip: float) -> dict:
                 f"bench_baseline.json pinned under protocol "
                 f"{pin_protocol!r}, harness runs {PROTOCOL!r} — re-pin with "
                 "--write-baseline"
+            ),
+        }
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    if pin_device is not None and pin_device != device_kind:
+        # a pin from different hardware is a unit error, not a baseline —
+        # same failure class the protocol check refuses
+        return {
+            "vs_baseline": None,
+            "pin_error": (
+                f"bench_baseline.json pinned on {pin_device!r}, this run is "
+                f"on {device_kind!r} — re-pin with --write-baseline"
             ),
         }
     return {"vs_baseline": round(sps_per_chip / pins[config], 3)}
@@ -745,22 +778,24 @@ def run_scaling(config: str = HEADLINE, run_kw: dict = None) -> dict:
     points, points_chips, point_errors = {}, {}, {}
     for k in sizes:
         # Small-k points run on sub-meshes of the FIRST k global devices; a
-        # process owning none of them cannot even dispatch the point (jit
-        # with zero addressable devices raises), so ownership is checked
-        # up front — the deterministic skip.  Anything run_config raises on
-        # an OWNING process is a real failure and is recorded per point
-        # (never swallowed: a pod sweep must not print green over a broken
-        # point), while single-process failures surface immediately.
-        owns_point = any(d.id < k for d in jax.local_devices())
-        if owns_point:
-            try:
-                r = run_config(config, num_workers=k, **run_kw)
-                points[str(k)] = r["value"]
-                points_chips[str(k)] = r["chips"]
-            except Exception as e:  # noqa: BLE001 — recorded in the verdict line
-                if jax.process_count() == 1:
-                    raise
-                point_errors[str(k)] = f"{type(e).__name__}: {e}"
+        # process owning none of them cannot dispatch the point (jit with
+        # zero addressable devices raises) and records the expected error
+        # locally — only process 0 prints, and it owns every point.  Real
+        # failures on an owning process land in the SAME per-point record
+        # and DO print (a pod sweep must not read green over a broken
+        # point); single-process failures surface immediately.  Every
+        # process must still ATTEMPT the point rather than skip by an
+        # ownership precheck: skipping desequences the Gloo group creation
+        # between the busy and idle processes and deadlocks the CPU-mesh
+        # rehearsal (measured: the precheck variant hangs in rendezvous).
+        try:
+            r = run_config(config, num_workers=k, **run_kw)
+            points[str(k)] = r["value"]
+            points_chips[str(k)] = r["chips"]
+        except Exception as e:  # noqa: BLE001 — recorded in the verdict line
+            if jax.process_count() == 1:
+                raise
+            point_errors[str(k)] = f"{type(e).__name__}: {e}"
         # Cross-process barrier per point — taken on EVERY path, success,
         # skip, or failure: a process that skipped a point (or aborted the
         # loop) would otherwise reach jax.distributed.shutdown minutes

@@ -88,17 +88,25 @@ def test_vs_baseline_refuses_cross_protocol_pins(monkeypatch, tmp_path):
 
 
 def test_write_baseline_roundtrip(monkeypatch, tmp_path):
+    import jax
+
     target = tmp_path / "pins.json"
     monkeypatch.setattr(bench, "BASELINE_FILE", str(target))
-    bench.write_baseline({"_device_kind": "TPU v5e",
+    live_kind = jax.devices()[0].device_kind
+    bench.write_baseline({"_device_kind": live_kind,
                           "mnist_mlp_single": 123.4})
     data = json.load(open(target))
     assert data["protocol"] == bench.PROTOCOL
     assert data["configs"] == {"mnist_mlp_single": 123.4}
-    assert data["device_kind"] == "TPU v5e"
+    assert data["device_kind"] == live_kind
     # and the comparison path accepts what write_baseline wrote
     out = bench._vs_baseline_fields("mnist_mlp_single", 123.4)
     assert out["vs_baseline"] == 1.0
+    # ...but refuses a pin taken on different hardware (unit-error class)
+    data["device_kind"] = "TPU imaginary9000"
+    json.dump(data, open(target, "w"))
+    out = bench._vs_baseline_fields("mnist_mlp_single", 123.4)
+    assert out["vs_baseline"] is None and "pin_error" in out
 
 
 def test_calibration_path_runs_and_clears_programs(monkeypatch):

@@ -195,9 +195,9 @@ def test_fsdp_composes_with_streaming(toy_classification):
 
 def test_fsdp_rejects_bad_combos():
     x, _, onehot = _data()
-    with pytest.raises(ValueError):
-        dk.DOWNPOUR(FlaxModel(MLP()), num_workers=4, fsdp=True,
-                    seq_shards=2).train(from_numpy(x, onehot))
+    # fsdp x seq_shards is now SUPPORTED (seq-axis ZeRO center sharding in
+    # the shard_map engine — tests/test_fsdp_sp.py, which also covers the
+    # remaining tp x seq rejection); fsdp x pipeline still rejects.
     with pytest.raises(ValueError):
         dk.DOWNPOUR(FlaxModel(MLP()), num_workers=4, fsdp=True,
                     pipeline_stages=2).train(from_numpy(x, onehot))
