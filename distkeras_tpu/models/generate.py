@@ -202,8 +202,9 @@ def greedy_generate_staged_pipelined(
     model trained across ``num_stages`` devices *because it doesn't fit one*
     couldn't generate (VERDICT r4 weak #5).  Here the ``stages`` mesh axis
     shards both: per-device residency is one stage's blocks + one stage's
-    cache; embed/head stay replicated (the documented staged-layout trade,
-    ``models/staged.py``).
+    cache; embed/head ride in replicated (a model TRAINED with stage-sharded
+    embed/head — ``PipelineEngine(fsdp=True)`` — decodes from its
+    host-gathered center, ``gather_center``, so decode sees full leaves).
 
     Schedule (the SPMD pipelining idiom of ``parallel/pipeline.py``): each
     decode chunk rides a ``num_stages``-iteration ring — every device applies

@@ -49,9 +49,32 @@ from distkeras_tpu.parallel.mesh import (
 )
 from distkeras_tpu.utils.pytree import tree_cast, tree_where
 
-__all__ = ["TrainState", "WindowedEngine", "plan_workers"]
+__all__ = ["TrainState", "WindowedEngine", "plan_workers",
+           "zero_shard_dim", "zero_gather_tree"]
 
 VWORKER_AXIS = "vworkers"
+
+
+def zero_shard_dim(shape, shards: int) -> int:
+    """The ONE ZeRO shard-placement policy: the largest dim of ``shape``
+    that splits evenly over ``shards`` with >=2 rows per shard, or -1 to
+    stay replicated.  Shared by the seq-axis fsdp (WindowedEngine) and the
+    stage-axis fsdp (PipelineEngine) so the two engines — and checkpoints
+    resumed across them — can never disagree on where a leaf shards."""
+    free = [d for d, s in enumerate(shape)
+            if s % shards == 0 and s >= 2 * shards]
+    return max(free, key=lambda d: shape[d]) if free else -1
+
+
+def zero_gather_tree(dims, tree, axis: str):
+    """Inside shard_map: materialise full leaves from their ``axis`` shards
+    (gather-at-use; ``dims`` is the int-tree ``zero_shard_dim`` produced).
+    ``all_gather``'s transpose is ``psum_scatter``, so differentiating
+    through this hands each shard its own summed-gradient block."""
+    return jax.tree.map(
+        lambda d, x: x if d < 0 else lax.all_gather(x, axis, axis=d, tiled=True),
+        dims, tree,
+    )
 
 
 def plan_workers(num_workers: int, n_devices: int) -> tuple[int, int]:
@@ -240,14 +263,9 @@ class WindowedEngine:
         table so block-shape recomputation can never pick a different dim."""
         if not self._fsdp_seq:
             return
-
-        def dim_for(x):
-            shape = np.shape(x)
-            free = [d for d, s in enumerate(shape)
-                    if s % self.seq_shards == 0 and s >= 2 * self.seq_shards]
-            return max(free, key=lambda d: shape[d]) if free else -1
-
-        self._center_fsdp_dims = jax.tree.map(dim_for, params)
+        self._center_fsdp_dims = jax.tree.map(
+            lambda x: zero_shard_dim(np.shape(x), self.seq_shards), params
+        )
         if all(d < 0 for d in jax.tree.leaves(self._center_fsdp_dims)):
             # fsdp=True with nothing shardable would silently store the
             # full center replicated — exactly the HBM redundancy the flag
@@ -278,11 +296,7 @@ class WindowedEngine:
         pre-layer all-gather)."""
         if not self._fsdp_seq:
             return tree
-        return jax.tree.map(
-            lambda d, x: x if d < 0 else lax.all_gather(
-                x, SEQ_AXIS, axis=d, tiled=True),
-            self._center_fsdp_dims, tree,
-        )
+        return zero_gather_tree(self._center_fsdp_dims, tree, SEQ_AXIS)
 
     def _fsdp_shard(self, tree):
         """Inside shard_map: keep only this seq-row's block of the updated

@@ -253,11 +253,12 @@ class Trainer:
             metrics = per_token_metric_names(metrics)
         feats, labels = self._load_columns(dataframe)
         if self.pipeline_stages > 1:
-            if self.seq_shards > 1 or self.fsdp:
+            if self.seq_shards > 1:
                 raise ValueError(
-                    "pipeline_stages>1 composes with data parallelism and "
-                    "tensor parallelism (tp_shards); seq_shards/fsdp are not "
-                    "supported with the pipeline engine in this release"
+                    "pipeline_stages>1 composes with data parallelism, "
+                    "tensor parallelism (tp_shards) and fsdp (stage-sharded "
+                    "embed/head); seq_shards is not supported with the "
+                    "pipeline engine in this release"
                 )
             if self.tp_spec_fn is not None:
                 raise ValueError(
@@ -287,6 +288,7 @@ class Trainer:
                 num_workers,
                 microbatches=self.pp_microbatches,
                 tp_shards=self.tp_shards,
+                fsdp=self.fsdp,
                 metrics=metrics,
                 compute_dtype=self.compute_dtype,
                 remat=self.remat,

@@ -4,7 +4,7 @@ point at BEYOND-driver scale.
 The driver runs ``dryrun_multichip(8)``; the 8->64-chip north star
 (BASELINE.md) means the first larger-mesh attempt should not be the first
 time those layouts compile.  This runs the full dryrun — dp, dp x sp,
-dp x tp+fsdp, dp x pp, dp x ep, and the three-axis dp x pp x tp grid — over
+dp x tp+fsdp, dp x pp x fsdp, dp x ep, and the three-axis dp x pp x tp grid — over
 a 16-device virtual mesh in a subprocess (device count is fixed at backend
 init, so it cannot reuse pytest's 8-device process).  32 devices compiles
 too (verified manually, ~minutes on this 1-core host); 16 keeps the suite's
@@ -29,6 +29,7 @@ def test_dryrun_multichip_16_devices():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     out = proc.stdout
     assert "dryrun_multichip(16): ok" in out
-    assert "dp x sp" in out and "dp x tp" in out and "dp x pp (" in out
+    assert "dp x sp" in out and "dp x tp" in out and "dp x pp x fsdp (" in out
     assert "dp x ep" in out
-    assert "dp x pp x tp (4 workers x 2 stages x 2 model): ok" in out
+    assert ("dp x pp x tp (+fsdp embed/head) (4 workers x 2 stages "
+            "x 2 model): ok") in out

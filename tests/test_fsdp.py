@@ -195,9 +195,10 @@ def test_fsdp_composes_with_streaming(toy_classification):
 
 def test_fsdp_rejects_bad_combos():
     x, _, onehot = _data()
-    # fsdp x seq_shards is now SUPPORTED (seq-axis ZeRO center sharding in
-    # the shard_map engine — tests/test_fsdp_sp.py, which also covers the
-    # remaining tp x seq rejection); fsdp x pipeline still rejects.
-    with pytest.raises(ValueError):
-        dk.DOWNPOUR(FlaxModel(MLP()), num_workers=4, fsdp=True,
+    # fsdp x seq_shards is SUPPORTED (seq-axis ZeRO center sharding,
+    # tests/test_fsdp_sp.py) and fsdp x pipeline is SUPPORTED (stage-sharded
+    # embed/head, tests/test_pp_fsdp.py); seq_shards x pipeline is the
+    # remaining rejected pair.
+    with pytest.raises(ValueError, match="seq_shards"):
+        dk.DOWNPOUR(FlaxModel(MLP()), num_workers=4, seq_shards=2,
                     pipeline_stages=2).train(from_numpy(x, onehot))

@@ -179,9 +179,11 @@ def test_trainer_pipeline_kwarg_validation():
 
     x, _, onehot = toy_text(n=32)
     df = dk.from_numpy(x, onehot)
-    t = dk.DOWNPOUR(_staged(num_stages=4), pipeline_stages=4, fsdp=True,
+    # fsdp x pipeline is SUPPORTED now (stage-sharded embed/head,
+    # tests/test_pp_fsdp.py); seq_shards x pipeline still rejects
+    t = dk.DOWNPOUR(_staged(num_stages=4), pipeline_stages=4, seq_shards=2,
                     num_workers=2, batch_size=8, num_epoch=1)
-    with pytest.raises(ValueError, match="seq_shards/fsdp are not"):
+    with pytest.raises(ValueError, match="seq_shards"):
         t.train(df)
     from distkeras_tpu.models import TextCNN
     t2 = dk.DOWNPOUR(FlaxModel(TextCNN(vocab_size=50, num_classes=2)),

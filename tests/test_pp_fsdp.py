@@ -1,0 +1,203 @@
+"""Pipeline x fsdp: ZeRO-style stage sharding of the non-staged leaves.
+
+The staged layout's documented trade (models/staged.py) was that the embed
+table and head are replicated on every stage device — at LM scale those
+dominate a stage's blocks.  ``PipelineEngine(fsdp=True)`` stores each
+evenly-splitting embed/head leaf (and its optimizer moments / rule state,
+which mirror param shapes) 1/num_stages per stage and all-gathers at use
+inside the pipelined view; ``all_gather``'s transpose (``psum_scatter``)
+hands each stage its own gradient shard, and the commit rules run
+elementwise on shards.  Sharding is layout, not math — the trajectory must
+equal the replicated-embed pipeline run exactly.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.algorithms import Downpour
+from distkeras_tpu.models import StagedLM, StagedTransformer
+from distkeras_tpu.parallel import PipelineEngine
+
+from conftest import epoch_data, toy_text
+
+
+def _staged(num_stages=2, per_stage=1):
+    return StagedTransformer(
+        vocab_size=50, num_classes=2, dim=32, heads=2,
+        num_stages=num_stages, blocks_per_stage=per_stage, max_len=64,
+    )
+
+
+def _engine(adapter, fsdp, *, optimizer=("sgd", {"learning_rate": 0.05}),
+            loss="categorical_crossentropy", devices=None):
+    return PipelineEngine(
+        adapter, loss, optimizer, Downpour(2),
+        num_workers=2, microbatches=2, metrics=(), fsdp=fsdp,
+        devices=devices if devices is not None else jax.devices()[:4],
+    )
+
+
+def _run(engine, xs, ys, epochs=2):
+    xs_d, ys_d = engine.shard_batches(xs, ys)
+    state = engine.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
+    losses = []
+    for _ in range(epochs):
+        state, stats = engine.run_epoch(state, xs_d, ys_d)
+        losses.append(np.asarray(stats["loss"]))
+    return engine.gather_center(state), np.concatenate(losses), state
+
+
+def test_pp_fsdp_trajectory_equals_replicated():
+    """2 workers x 2 stages, sharded vs replicated embed/head: identical
+    losses and center (the gather/scatter round-trip adds no math)."""
+    x, _, onehot = toy_text()
+    xs, ys = epoch_data(x, onehot, num_workers=2, n_windows=2, window=2, batch=8)
+
+    center_f, loss_f, _ = _run(_engine(_staged(), True), xs, ys)
+    center_r, loss_r, _ = _run(_engine(_staged(), False), xs, ys)
+
+    np.testing.assert_allclose(loss_f, loss_r, rtol=2e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(center_f), jax.tree.leaves(center_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_pp_fsdp_state_is_stage_sharded():
+    """The vocab embedding — the leaf the flag exists for — stores
+    1/num_stages per device, in center, local and optimizer trees; the
+    layout survives an epoch (the scan carry is not re-replicated)."""
+    x, _, onehot = toy_text(n=64)
+    xs, ys = epoch_data(x, onehot, num_workers=2, n_windows=1, window=2, batch=8)
+    eng = _engine(_staged(), True,
+                  optimizer=("adam", {"learning_rate": 1e-3}))
+    xs_d, ys_d = eng.shard_batches(xs, ys)
+    state = eng.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
+    state, _ = eng.run_epoch(state, xs_d, ys_d)
+
+    tok = state.center_params["embed"]["tok_embed"]["embedding"]
+    assert tok.shape == (50, 32)
+    shard = tok.addressable_shards[0].data.shape
+    assert shard == (25, 32), shard
+
+    ltok = state.local_params["embed"]["tok_embed"]["embedding"]
+    assert ltok.shape == (2, 50, 32)
+    lshard = ltok.addressable_shards[0].data.shape
+    assert lshard == (1, 25, 32), lshard
+
+    # adam moments mirror the param shapes and must ride the same layout
+    # (ZeRO's actual point: no device holds another stage's moments)
+    moments = [l for l in jax.tree.leaves(state.opt_state)
+               if l.shape == (2, 50, 32)]
+    assert moments, "expected param-shaped adam moment leaves"
+    for m in moments:
+        assert m.addressable_shards[0].data.shape == (1, 25, 32)
+
+    # non-divisible leaves (the 2-wide head bias) stay replicated
+    bias = state.center_params["head"]["out"]["bias"]
+    assert bias.addressable_shards[0].data.shape == bias.shape
+
+
+def test_pp_fsdp_staged_lm_trains():
+    """fsdp on the staged causal LM — vocab-sharded embedding AND head
+    under per-token labels — still converges."""
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 32, size=(128, 16)).astype(np.int32)
+    xs, ys = epoch_data(x, x, num_workers=2, n_windows=2, window=2, batch=8)
+    ys = ys.astype(np.int32)
+    adapter = StagedLM(vocab_size=32, dim=32, heads=2, num_stages=2,
+                       blocks_per_stage=1, max_len=16)
+    eng = _engine(adapter, True, loss="token_crossentropy",
+                  optimizer=("adam", {"learning_rate": 2e-3}))
+    xs_d, ys_d = eng.shard_batches(xs, ys)
+    state = eng.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
+    losses = []
+    for _ in range(6):
+        state, stats = eng.run_epoch(state, xs_d, ys_d)
+        losses.append(float(np.asarray(stats["loss"]).mean()))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_pp_fsdp_state_from_center_resumes():
+    """Elastic resume rebuilds a SHARDED pipeline state from host center
+    trees (this also covers the pipeline engine's state_from_center path,
+    which previously had no coverage at all)."""
+    x, _, onehot = toy_text(n=64)
+    xs, ys = epoch_data(x, onehot, num_workers=2, n_windows=1, window=2, batch=8)
+    eng = _engine(_staged(), True)
+    xs_d, ys_d = eng.shard_batches(xs, ys)
+    state = eng.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
+    state, _ = eng.run_epoch(state, xs_d, ys_d)
+    center_host = jax.tree.map(np.asarray, eng.gather_center(state))
+    rule_host = jax.tree.map(np.asarray, state.center_rule)
+
+    fresh = _engine(_staged(), True)
+    resumed = fresh.state_from_center(
+        jax.random.PRNGKey(1), center_host, rule_host, {}, 1,
+    )
+    tok = resumed.center_params["embed"]["tok_embed"]["embedding"]
+    assert tok.addressable_shards[0].data.shape == (25, 32)
+    np.testing.assert_array_equal(
+        np.asarray(tok),
+        center_host["embed"]["tok_embed"]["embedding"],
+    )
+    # and the resumed state trains
+    resumed, stats = fresh.run_epoch(resumed, xs_d, ys_d)
+    assert np.isfinite(np.asarray(stats["loss"])).all()
+
+
+def test_pp_fsdp_through_trainer_api():
+    """DOWNPOUR(..., pipeline_stages=2, fsdp=True) end to end."""
+    import distkeras_tpu as dk
+
+    x, y, onehot = toy_text(n=256)
+    df = dk.from_numpy(x, onehot)
+    t = dk.DOWNPOUR(_staged(), loss="categorical_crossentropy",
+                    worker_optimizer=("adam", {"learning_rate": 2e-3}),
+                    num_workers=4, batch_size=16, num_epoch=10,
+                    communication_window=2, pipeline_stages=2, fsdp=True)
+    trained = t.train(df)
+    h = t.get_history()["loss"]
+    assert h[-1] < h[0] * 0.8, h
+    preds = trained.predict(x)
+    assert np.mean(np.argmax(preds, -1) == y) > 0.75
+
+
+def test_pp_tp_fsdp_trajectory_matches_pp_fsdp():
+    """Three axes + stage-sharded embed/head: 2 workers x 2 stages x 2
+    model with fsdp equals the 2-axis fsdp run (the auto model axis and
+    the stage sharding are both layout, not math) — backs the README's
+    'composes with tp_shards' claim with an assertion."""
+    x, _, onehot = toy_text()
+    xs, ys = epoch_data(x, onehot, num_workers=2, n_windows=2, window=2, batch=8)
+
+    tp = PipelineEngine(_staged(), "categorical_crossentropy",
+                        ("sgd", {"learning_rate": 0.05}), Downpour(2),
+                        num_workers=2, microbatches=2, metrics=(),
+                        tp_shards=2, fsdp=True)
+    center_tp, loss_tp, _ = _run(tp, xs, ys)
+    center_f, loss_f, _ = _run(_engine(_staged(), True), xs, ys)
+
+    np.testing.assert_allclose(loss_tp, loss_f, rtol=2e-4, atol=2e-5)
+    for a, b in zip(jax.tree.leaves(center_tp), jax.tree.leaves(center_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_pp_fsdp_rejects_unauditable_optimizers():
+    """Custom optax transforms may reduce across parameters (global-norm
+    clipping) — stage-inconsistent on sharded leaves; fsdp=True accepts
+    only named (elementwise) optimizers."""
+    import optax
+
+    with pytest.raises(ValueError, match="named worker"):
+        PipelineEngine(_staged(), "categorical_crossentropy",
+                       optax.sgd(0.05), Downpour(2), fsdp=True,
+                       devices=jax.devices()[:4], num_workers=2)
+
+
+def test_pp_fsdp_single_stage_rejected():
+    with pytest.raises(ValueError, match="num_stages"):
+        PipelineEngine(_staged(num_stages=1), "categorical_crossentropy",
+                       "sgd", Downpour(2), fsdp=True,
+                       devices=jax.devices()[:2])
