@@ -14,6 +14,7 @@ from distkeras_tpu.models.moe import (
     expert_partition,
 )
 from distkeras_tpu.models.hf import HuggingFaceModel
+from distkeras_tpu.models.hf_staged import PretrainedStagedLM, gpt2_to_staged
 from distkeras_tpu.models.generate import greedy_generate
 from distkeras_tpu.models.staged import StagedLM, StagedTransformer
 from distkeras_tpu.models.transformer import (
@@ -45,4 +46,6 @@ __all__ = [
     "MoETransformerClassifier",
     "expert_partition",
     "HuggingFaceModel",
+    "PretrainedStagedLM",
+    "gpt2_to_staged",
 ]

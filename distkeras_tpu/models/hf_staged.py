@@ -1,0 +1,178 @@
+"""Pretrained GPT-2 -> :class:`StagedLM`: HuggingFace checkpoints on the
+pipeline mesh.
+
+:class:`~distkeras_tpu.models.hf.HuggingFaceModel` already trains any
+``transformers`` Flax model through the data/tensor/sequence axes, but an HF
+module is a black box to the PIPELINE engine, which needs the staged
+``{"embed", "blocks", "head"}`` layout with homogeneous blocks stacked
+``[num_stages, blocks_per_stage, ...]``.  GPT-2's architecture is exactly
+our :class:`TransformerEncoderBlock` — pre-LN, tanh-GELU 4x MLP, learned
+positions, final LayerNorm, causal attention at ``1/sqrt(head_dim)`` — so a
+checkpoint converts by pure weight re-layout, no re-expression of the math:
+
+  * ``wte``/``wpe``            -> ``embed.tok_embed/pos_embed``
+  * per block: ``ln_1``        -> ``LayerNorm_0``
+  *   ``attn.c_attn`` [3d, d]  -> ``_SelfAttention_0.qkv``  [d, 3, h, hd]
+  *   ``attn.c_proj`` [d, d]   -> ``_SelfAttention_0.proj`` [h, hd, d]
+  *   ``ln_2``                 -> ``LayerNorm_1``
+  *   ``mlp.c_fc/c_proj``      -> ``Dense_0`` / ``Dense_1``
+  * ``ln_f``                   -> ``head.LayerNorm_0``
+  * ``wte^T`` (tied) or the checkpoint's own ``lm_head`` (untied —
+    ``cfg.tie_word_embeddings=False``) -> ``head.out``; either way the
+    staged layout is untied from here on: fine-tuning trains embed and
+    head independently, like every reference-style Keras model
+
+HF's ``FlaxConv1D`` stores kernels ``(out, in)`` and transposes at use
+(``modeling_flax_gpt2.FlaxConv1D``), hence the ``.T`` on every kernel.
+Equality is asserted, not assumed: ``tests/test_hf_staged.py`` checks
+converted logits against the HF model's own forward pass.
+
+The returned adapter's ``init`` adopts the converted weights (the
+:class:`HuggingFaceModel` convention), so the checkpoint becomes the
+initial center variable for any trainer — including
+``pipeline_stages=S, fsdp=True``, where the [vocab, dim] embedding and
+head this conversion produces are exactly the leaves the stage-sharding
+exists for.  ``greedy_generate`` / ``greedy_generate_staged_pipelined``
+decode it unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+
+from distkeras_tpu.models.staged import StagedLM
+
+__all__ = ["PretrainedStagedLM", "gpt2_to_staged"]
+
+#: tanh-approximation GELU names (== flax.linen.gelu(approximate=True))
+_TANH_GELUS = ("gelu_new", "gelu_pytorch_tanh")
+
+
+@dataclasses.dataclass
+class PretrainedStagedLM(StagedLM):
+    """A :class:`StagedLM` whose ``init`` adopts converted pretrained
+    weights instead of sampling fresh ones (rng is unused, like
+    :class:`HuggingFaceModel.init`)."""
+
+    def init(self, rng, sample_input):
+        del rng, sample_input
+        if getattr(self, "_pretrained", None) is None:
+            raise RuntimeError("construct via gpt2_to_staged()")
+        # Host (numpy) leaves go out untouched: the engines' jitted state
+        # builds place them under their target shardings in one transfer.
+        # An eager jnp.asarray here would first materialise the full
+        # checkpoint replicated on one device — the exact spike the
+        # fsdp/stage shardings exist to avoid (engine.state_from_center
+        # makes the same choice).
+        return jax.tree.map(lambda x: x, self._pretrained), {}
+
+
+def _require(cond, msg):
+    if not cond:
+        raise ValueError(msg)
+
+
+def gpt2_to_staged(model, num_stages: int,
+                   blocks_per_stage: Optional[int] = None) -> PretrainedStagedLM:
+    """Convert a ``FlaxGPT2LMHeadModel`` (pretrained or fresh) into a
+    pipeline-ready :class:`PretrainedStagedLM`."""
+    cfg = model.config
+    _require(
+        type(model).__name__ == "FlaxGPT2LMHeadModel",
+        f"gpt2_to_staged converts FlaxGPT2LMHeadModel, got {type(model).__name__}",
+    )
+    _require(
+        cfg.activation_function in _TANH_GELUS,
+        f"block uses tanh-GELU; checkpoint has {cfg.activation_function!r}",
+    )
+    _require(
+        cfg.n_inner is None or cfg.n_inner == 4 * cfg.n_embd,
+        f"block MLP is 4x wide; checkpoint has n_inner={cfg.n_inner}",
+    )
+    _require(
+        getattr(cfg, "scale_attn_weights", True)
+        and not getattr(cfg, "scale_attn_by_inverse_layer_idx", False)
+        and not getattr(cfg, "reorder_and_upcast_attn", False),
+        "checkpoint uses non-standard attention scaling",
+    )
+    n_layer = int(cfg.n_layer)
+    if blocks_per_stage is None:
+        _require(
+            n_layer % num_stages == 0,
+            f"n_layer={n_layer} does not divide into {num_stages} stages",
+        )
+        blocks_per_stage = n_layer // num_stages
+    _require(
+        num_stages * blocks_per_stage == n_layer,
+        f"{num_stages} x {blocks_per_stage} != n_layer={n_layer}",
+    )
+
+    dim, heads = int(cfg.n_embd), int(cfg.n_head)
+    hd = dim // heads
+    t = model.params["transformer"]
+    f32 = lambda x: np.asarray(x, np.float32)
+
+    def block_params(i):
+        blk = t["h"][str(i)]
+        return {
+            "LayerNorm_0": {k: f32(v) for k, v in blk["ln_1"].items()},
+            "_SelfAttention_0": {
+                "qkv": {
+                    "kernel": f32(blk["attn"]["c_attn"]["kernel"]).T.reshape(
+                        dim, 3, heads, hd),
+                    "bias": f32(blk["attn"]["c_attn"]["bias"]).reshape(
+                        3, heads, hd),
+                },
+                "proj": {
+                    "kernel": f32(blk["attn"]["c_proj"]["kernel"]).T.reshape(
+                        heads, hd, dim),
+                    "bias": f32(blk["attn"]["c_proj"]["bias"]),
+                },
+            },
+            "LayerNorm_1": {k: f32(v) for k, v in blk["ln_2"].items()},
+            "Dense_0": {"kernel": f32(blk["mlp"]["c_fc"]["kernel"]).T,
+                        "bias": f32(blk["mlp"]["c_fc"]["bias"])},
+            "Dense_1": {"kernel": f32(blk["mlp"]["c_proj"]["kernel"]).T,
+                        "bias": f32(blk["mlp"]["c_proj"]["bias"])},
+        }
+
+    per_block = [block_params(i) for i in range(n_layer)]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *per_block)
+    stacked = jax.tree.map(
+        lambda x: x.reshape((num_stages, blocks_per_stage) + x.shape[1:]),
+        stacked,
+    )
+    wte = f32(t["wte"]["embedding"])
+    vocab = wte.shape[0]
+    if getattr(cfg, "tie_word_embeddings", True):
+        head_kernel = wte.T.copy()
+    else:
+        # untied checkpoints carry their own head (HF's FlaxGPT2LMHeadModule
+        # uses params["lm_head"] instead of wte^T); nn.Dense kernels are
+        # already (in, out) — no transpose
+        head_kernel = f32(model.params["lm_head"]["kernel"])
+        _require(
+            head_kernel.shape == (dim, vocab),
+            f"untied lm_head kernel has shape {head_kernel.shape}, "
+            f"expected {(dim, vocab)}",
+        )
+    params = {
+        "embed": {"tok_embed": {"embedding": wte},
+                  "pos_embed": {"embedding": f32(t["wpe"]["embedding"])}},
+        "blocks": stacked,
+        "head": {"LayerNorm_0": {k: f32(v) for k, v in t["ln_f"].items()},
+                 "out": {"kernel": head_kernel,
+                         "bias": np.zeros((vocab,), np.float32)}},
+    }
+
+    staged = PretrainedStagedLM(
+        vocab_size=vocab, dim=dim, heads=heads,
+        num_stages=num_stages, blocks_per_stage=blocks_per_stage,
+        max_len=int(cfg.n_positions), ln_eps=float(cfg.layer_norm_epsilon),
+    )
+    staged._pretrained = params
+    return staged

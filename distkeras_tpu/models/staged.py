@@ -10,9 +10,13 @@ along a leading ``[num_stages]`` axis so they shard cleanly over a ``stages``
 mesh axis, and microbatches stream through the stages via ``ppermute``
 neighbour exchanges (see :mod:`distkeras_tpu.parallel.pipeline`).
 
-The embedding and the classifier head are deliberately *not* staged: they are
-small next to the block stack, stay replicated, and are computed by every
-stage device (masked into the pipeline on stage 0 / the last stage).
+The embedding and the classifier head are deliberately *not* staged: they
+stay replicated and are computed by every stage device (masked into the
+pipeline on stage 0 / the last stage).  When they are NOT small next to the
+block stack — vocab-scale LM embeddings and heads — ``PipelineEngine(...,
+fsdp=True)`` stores them (and their optimizer state) sharded 1/num_stages
+per device and all-gathers at use (:mod:`distkeras_tpu.parallel.pipeline`),
+trajectory-identical to the replicated layout.
 
 ``StagedTransformer`` is a plain :class:`ModelAdapter` whose ``apply`` runs
 the stages **sequentially** — the single-device reference semantics used for
@@ -52,20 +56,22 @@ class _Embed(nn.Module):
 
 class _Head(nn.Module):
     num_classes: int
+    ln_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x):
-        x = nn.LayerNorm()(x)
+        x = nn.LayerNorm(epsilon=self.ln_eps)(x)
         token_logits = nn.Dense(self.num_classes, name="out")(x)
         return token_logits.sum(axis=1) / x.shape[1]
 
 
 class _LMHead(nn.Module):
     vocab_size: int
+    ln_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x):
-        x = nn.LayerNorm()(x)
+        x = nn.LayerNorm(epsilon=self.ln_eps)(x)
         return nn.Dense(self.vocab_size, name="out")(x)  # [b, seq, vocab]
 
 
@@ -88,6 +94,7 @@ class StagedTransformer(ModelAdapter):
     num_stages: int = 2
     blocks_per_stage: int = 1
     max_len: int = 2048
+    ln_eps: float = 1e-6  # 1e-5 for GPT-2 checkpoints (models/hf_staged.py)
     outputs_logits: bool = True
 
     def __post_init__(self):
@@ -96,10 +103,10 @@ class StagedTransformer(ModelAdapter):
         self._head = self._make_head()
 
     def _make_block(self):
-        return TransformerEncoderBlock(self.dim, self.heads)
+        return TransformerEncoderBlock(self.dim, self.heads, ln_eps=self.ln_eps)
 
     def _make_head(self):
-        return _Head(self.num_classes)
+        return _Head(self.num_classes, ln_eps=self.ln_eps)
 
     # ------------------------------------------------------------------ init
     def init(self, rng: jax.Array, sample_input) -> Tuple[Any, Any]:
@@ -176,10 +183,11 @@ class StagedLM(StagedTransformer):
     def _make_block(self):
         # max_len sizes the per-block KV cache for decode (training ignores it)
         return TransformerEncoderBlock(self.dim, self.heads, causal=True,
-                                       max_len=self.max_len)
+                                       max_len=self.max_len,
+                                       ln_eps=self.ln_eps)
 
     def _make_head(self):
-        return _LMHead(self.vocab_size)
+        return _LMHead(self.vocab_size, ln_eps=self.ln_eps)
 
     # ------------------------------------------------------- KV-cache decode
     def init_cache(self, batch_size: int, dtype=jnp.float32):

@@ -95,16 +95,17 @@ class TransformerEncoderBlock(nn.Module):
     causal: bool = False
     dropout: float = 0.0
     max_len: Optional[int] = None  # KV-cache capacity (decode mode only)
+    ln_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5 (models/hf_staged.py)
 
     @nn.compact
     def __call__(self, x, training: bool = False, decode: bool = False):
-        h = nn.LayerNorm()(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps)(x)
         h = _SelfAttention(self.dim, self.heads, self.seq_axis, self.causal,
                            self.max_len)(h, training, decode)
         if self.dropout > 0:
             h = nn.Dropout(self.dropout, deterministic=not training)(h)
         x = x + h
-        h = nn.LayerNorm()(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps)(x)
         h = nn.Dense(self.dim * self.mlp_ratio)(h)
         h = nn.gelu(h)
         h = nn.Dense(self.dim)(h)
