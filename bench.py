@@ -458,8 +458,18 @@ def _emit_error(message: str, metric: str = HEADLINE_METRIC):
         "unit": "samples/sec/chip",
         "vs_baseline": None,
         "mfu": None,
+        "status": "error",
         "error": message,
     }))
+
+
+def _ok_line(result: dict) -> str:
+    """Serialize a result with an at-a-glance verdict.  The deadman design
+    (rc 0 + error lines) means the process exit code never carries the
+    verdict — a reader skimming only `value` could mistake an error row
+    for a measurement (round-4 review).  Every line now says which it is."""
+    result.setdefault("status", "error" if result.get("error") else "ok")
+    return json.dumps(result)
 
 
 class _Deadman:
@@ -1135,7 +1145,7 @@ def main():
         pinned_results[config] = result["value"]
         if config == HEADLINE:
             result["metric"] = HEADLINE_METRIC
-        emit(json.dumps(result))
+        emit(_ok_line(result))
         pending.pop(0)
 
     if args.write_baseline and jax.process_index() == 0:
@@ -1150,7 +1160,7 @@ def main():
         deadman.arm(args.config_timeout, pending)
         line = None
         try:
-            line = json.dumps(run_scaling(args.scaling_config, run_kw))
+            line = _ok_line(run_scaling(args.scaling_config, run_kw))
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
             deadman.disarm()
             _emit_error(f"{type(e).__name__}: {e}",
@@ -1165,7 +1175,7 @@ def main():
         deadman.arm(args.config_timeout, pending)
         line = None
         try:
-            line = json.dumps(run_streaming())
+            line = _ok_line(run_streaming())
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
             deadman.disarm()
             _emit_error(f"{type(e).__name__}: {e}",
@@ -1181,7 +1191,7 @@ def main():
             deadman.arm(args.config_timeout, pending)
             line = None
             try:
-                line = json.dumps(run_mfu_ceiling(config))
+                line = _ok_line(run_mfu_ceiling(config))
             except Exception as e:  # noqa: BLE001 — one JSON line, always
                 deadman.disarm()
                 _emit_error(f"{type(e).__name__}: {e}",

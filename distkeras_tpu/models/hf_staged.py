@@ -44,7 +44,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from distkeras_tpu.models.staged import StagedLM
+from distkeras_tpu.models.staged import StagedLM, stack_block_params
 
 __all__ = ["PretrainedStagedLM", "gpt2_to_staged"]
 
@@ -141,11 +141,9 @@ def gpt2_to_staged(model, num_stages: int,
         }
 
     per_block = [block_params(i) for i in range(n_layer)]
-    stacked = jax.tree.map(lambda *xs: np.stack(xs), *per_block)
-    stacked = jax.tree.map(
-        lambda x: x.reshape((num_stages, blocks_per_stage) + x.shape[1:]),
-        stacked,
-    )
+    # xp=np keeps the converted checkpoint as host leaves (the engines'
+    # jitted builds place shards directly)
+    stacked = stack_block_params(per_block, num_stages, blocks_per_stage, xp=np)
     wte = f32(t["wte"]["embedding"])
     vocab = wte.shape[0]
     if getattr(cfg, "tie_word_embeddings", True):

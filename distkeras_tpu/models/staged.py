@@ -38,7 +38,21 @@ from jax import lax
 from distkeras_tpu.models.adapter import ModelAdapter
 from distkeras_tpu.models.transformer import TransformerEncoderBlock
 
-__all__ = ["StagedTransformer", "StagedLM"]
+__all__ = ["StagedTransformer", "StagedLM", "stack_block_params"]
+
+
+def stack_block_params(per_block, num_stages, blocks_per_stage, xp=jnp):
+    """Fold a list of per-block param trees into the staged
+    ``[num_stages, blocks_per_stage, ...]`` leaf layout — THE contract
+    :class:`~distkeras_tpu.parallel.pipeline.PipelineEngine`'s stage
+    sharding relies on, kept in one place so init and checkpoint
+    conversion (``models/hf_staged.py``) cannot drift.  ``xp=np`` keeps
+    converted checkpoints as host leaves (no eager device transfer)."""
+    stacked = jax.tree.map(lambda *xs: xp.stack(xs), *per_block)
+    return jax.tree.map(
+        lambda x: x.reshape((num_stages, blocks_per_stage) + x.shape[1:]),
+        stacked,
+    )
 
 
 class _Embed(nn.Module):
@@ -121,10 +135,8 @@ class StagedTransformer(ModelAdapter):
             self._block.init(jax.random.fold_in(r_blocks, i), h)["params"]
             for i in range(n_blocks)
         ]
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *block_ps)
-        stacked = jax.tree.map(
-            lambda x: x.reshape((self.num_stages, self.blocks_per_stage) + x.shape[1:]),
-            stacked,
+        stacked = stack_block_params(
+            block_ps, self.num_stages, self.blocks_per_stage
         )
         head_p = self._head.init(r_head, h)["params"]
         return {"embed": embed_p, "blocks": stacked, "head": head_p}, {}

@@ -12,6 +12,10 @@ transformer LM on a synthetic next-token corpus five ways —
   5. HuggingFace fine-tune    (a transformers FlaxGPT2LMHeadModel through
                                the same trainer — its params are the
                                initial center, as from_pretrained's would be)
+  6. GPT-2 on the pipeline    (gpt2_to_staged re-lays the checkpoint into
+                               the staged layout; pipeline_stages=2 +
+                               fsdp=True stage-shards embed/head; decode
+                               through the pipelined executor)
 
 — then greedily generates from the trained model with a carried KV cache
 (one jitted prefill + scan program; see distkeras_tpu/models/generate.py).  Runs on a faked
@@ -122,6 +126,27 @@ def main():
         report("HF GPT-2 fine-tune (4w)", dk.DOWNPOUR(
             hf, worker_optimizer=("adam", {"learning_rate": 3e-3}),
             num_workers=4, **common))
+
+        # 6. the same checkpoint ONTO THE PIPELINE MESH: gpt2_to_staged
+        #    re-lays the weights into the staged layout (logit-identical —
+        #    tests/test_hf_staged.py), fsdp=True stage-shards the
+        #    vocab-scale embedding/head, and decode runs through the
+        #    pipelined executor (one stage's blocks + KV cache per device)
+        from distkeras_tpu.models import gpt2_to_staged
+        from distkeras_tpu.models.generate import greedy_generate_staged_pipelined
+
+        hf2 = FlaxGPT2LMHeadModel(
+            GPT2Config(vocab_size=VOCAB, n_positions=SEQ, n_embd=32,
+                       n_layer=2, n_head=2, resid_pdrop=0.0,
+                       embd_pdrop=0.0, attn_pdrop=0.0),
+            seed=0, input_shape=(1, 8))
+        staged = gpt2_to_staged(hf2, num_stages=2)
+        tuned = report("GPT-2 on pipeline+fsdp (4w x 2st)", dk.DOWNPOUR(
+            staged, worker_optimizer=("adam", {"learning_rate": 3e-3}),
+            num_workers=4, pipeline_stages=2, fsdp=True, **common))
+        pp_ctx = greedy_generate_staged_pipelined(
+            staged, tuned.params, x[:1, :8], 6, devices=jax.devices()[:2])
+        print("pipelined GPT-2 generation:", pp_ctx[0, 8:])
 
     ctx = generate(trained, x[:1, :8])
     print("greedy generation:", ctx[0, 8:], "from context ending at", ctx[0, 7])

@@ -251,6 +251,18 @@ def test_run_streaming_schema(monkeypatch):
     json.dumps(out)
 
 
+def test_every_line_carries_an_at_a_glance_status(capsys):
+    """rc is always 0 by deadman design, so the verdict must live in the
+    line itself: success lines say status=ok, error lines status=error —
+    including results that return an error field through the normal path
+    (the no-peak-table mfu ceiling)."""
+    assert json.loads(bench._ok_line({"metric": "m", "value": 1.0}))["status"] == "ok"
+    ceiling = bench.run_mfu_ceiling("mnist_mlp_single")  # CPU: no peak entry
+    assert json.loads(bench._ok_line(ceiling))["status"] == "error"
+    bench._emit_error("boom")
+    assert json.loads(capsys.readouterr().out.strip())["status"] == "error"
+
+
 def test_emit_error_is_parseable_json(capsys):
     bench._emit_error("TPU fell over")
     line = capsys.readouterr().out.strip()

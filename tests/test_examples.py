@@ -56,10 +56,12 @@ def test_lm_example_learns_and_generates():
     accs = [float(v) for v in re.findall(r"token-acc ([0-9.]+)", out)]
     try:
         import transformers  # noqa: F401 — optional dep mirrors the example
-        expected = 5
+        expected = 6  # incl. HF fine-tune + GPT-2 on pipeline+fsdp
     except ImportError:
-        expected = 4  # the example skips its HF variant without transformers
+        expected = 4  # the example skips its HF variants without transformers
     assert len(accs) == expected and all(a > 0.9 for a in accs), out
+    if expected == 6:
+        assert re.search(r"pipelined GPT-2 generation: \[[0-9 ]+\]", out), out
     gen = re.search(r"greedy generation: \[([0-9 ]+)\]", out)
     assert gen is not None, out
 
