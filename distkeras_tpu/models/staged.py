@@ -28,7 +28,7 @@ model.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -109,6 +109,11 @@ class StagedTransformer(ModelAdapter):
     blocks_per_stage: int = 1
     max_len: int = 2048
     ln_eps: float = 1e-6  # 1e-5 for GPT-2 checkpoints (models/hf_staged.py)
+    #: set to the seq mesh axis name for pipeline x sequence parallelism:
+    #: blocks run ring attention over it and the engine shards tokens/labels
+    #: along it (PipelineEngine(seq_shards=k)); decode needs a seq_axis=None
+    #: twin — `dataclasses.replace(model, seq_axis=None)`, same params
+    seq_axis: Optional[str] = None
     outputs_logits: bool = True
 
     def __post_init__(self):
@@ -117,7 +122,9 @@ class StagedTransformer(ModelAdapter):
         self._head = self._make_head()
 
     def _make_block(self):
-        return TransformerEncoderBlock(self.dim, self.heads, ln_eps=self.ln_eps)
+        return TransformerEncoderBlock(self.dim, self.heads,
+                                       seq_axis=self.seq_axis,
+                                       ln_eps=self.ln_eps)
 
     def _make_head(self):
         return _Head(self.num_classes, ln_eps=self.ln_eps)
@@ -193,9 +200,12 @@ class StagedLM(StagedTransformer):
         super().__post_init__()
 
     def _make_block(self):
-        # max_len sizes the per-block KV cache for decode (training ignores it)
+        # max_len sizes the per-block KV cache for decode (training ignores
+        # it); with seq_axis set, attention is CAUSAL RING attention and
+        # decode requires the seq_axis=None twin (see StagedTransformer)
         return TransformerEncoderBlock(self.dim, self.heads, causal=True,
                                        max_len=self.max_len,
+                                       seq_axis=self.seq_axis,
                                        ln_eps=self.ln_eps)
 
     def _make_head(self):

@@ -66,6 +66,20 @@ def zero_shard_dim(shape, shards: int) -> int:
     return max(free, key=lambda d: shape[d]) if free else -1
 
 
+def init_on_mesh(adapter, rng, sample_input, mesh, seq_axis: str):
+    """Init a seq-axis-aware model INSIDE the mesh program with the
+    sample's sequence (last) axis sharded — ring-attention blocks use
+    ``lax.axis_index``/``ppermute`` during their forward pass, so init
+    cannot run outside ``shard_map``.  The one recipe both the windowed
+    and the pipeline engine's sp paths use."""
+    sample = jnp.asarray(sample_input)
+    spec = P(*([None] * (sample.ndim - 1)), seq_axis)
+    return jax.shard_map(
+        lambda smp: adapter.init(rng, smp),
+        mesh=mesh, in_specs=(spec,), out_specs=P(), check_vma=False,
+    )(sample)
+
+
 def zero_gather_tree(dims, tree, axis: str):
     """Inside shard_map: materialise full leaves from their ``axis`` shards
     (gather-at-use; ``dims`` is the int-tree ``zero_shard_dim`` produced).
@@ -233,15 +247,9 @@ class WindowedEngine:
     # ------------------------------------------------------------------ init
     def init_state(self, rng: jax.Array, sample_input) -> TrainState:
         if self.seq_axis is not None:
-            # seq-axis-aware models use lax.axis_index/psum during their
-            # forward pass, so even init must run inside the mesh program,
-            # with the sample's sequence (last) axis sharded.
-            sample = jnp.asarray(sample_input)
-            spec = P(*([None] * (sample.ndim - 1)), self.seq_axis)
-            params, model_state = jax.shard_map(
-                lambda s: self.adapter.init(rng, s),
-                mesh=self.mesh, in_specs=(spec,), out_specs=P(), check_vma=False,
-            )(sample)
+            params, model_state = init_on_mesh(
+                self.adapter, rng, sample_input, self.mesh, self.seq_axis
+            )
         else:
             params, model_state = self.adapter.init(rng, sample_input)
         self._record_fsdp_dims(params)

@@ -253,13 +253,6 @@ class Trainer:
             metrics = per_token_metric_names(metrics)
         feats, labels = self._load_columns(dataframe)
         if self.pipeline_stages > 1:
-            if self.seq_shards > 1:
-                raise ValueError(
-                    "pipeline_stages>1 composes with data parallelism, "
-                    "tensor parallelism (tp_shards) and fsdp (stage-sharded "
-                    "embed/head); seq_shards is not supported with the "
-                    "pipeline engine in this release"
-                )
             if self.tp_spec_fn is not None:
                 raise ValueError(
                     "tp_spec_fn is a GSPMD-engine override; the pipeline "
@@ -288,6 +281,7 @@ class Trainer:
                 num_workers,
                 microbatches=self.pp_microbatches,
                 tp_shards=self.tp_shards,
+                seq_shards=self.seq_shards,
                 fsdp=self.fsdp,
                 metrics=metrics,
                 compute_dtype=self.compute_dtype,

@@ -33,3 +33,5 @@ def test_dryrun_multichip_16_devices():
     assert "dp x ep" in out
     assert ("dp x pp x tp (+fsdp embed/head) (4 workers x 2 stages "
             "x 2 model): ok") in out
+    assert ("dp x pp x sp x fsdp causal LM (4 workers x 2 stages "
+            "x 2 seq): ok") in out

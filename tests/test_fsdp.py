@@ -195,10 +195,10 @@ def test_fsdp_composes_with_streaming(toy_classification):
 
 def test_fsdp_rejects_bad_combos():
     x, _, onehot = _data()
-    # fsdp x seq_shards is SUPPORTED (seq-axis ZeRO center sharding,
-    # tests/test_fsdp_sp.py) and fsdp x pipeline is SUPPORTED (stage-sharded
-    # embed/head, tests/test_pp_fsdp.py); seq_shards x pipeline is the
-    # remaining rejected pair.
-    with pytest.raises(ValueError, match="seq_shards"):
+    # Every fsdp pair is SUPPORTED now (x sp: tests/test_fsdp_sp.py,
+    # x pp: tests/test_pp_fsdp.py) and so is pipeline x seq
+    # (tests/test_pp_sp.py) — but the latter needs a ring-attention staged
+    # adapter; an MLP through pipeline+seq must still fail loudly.
+    with pytest.raises(ValueError, match="staged adapter"):
         dk.DOWNPOUR(FlaxModel(MLP()), num_workers=4, seq_shards=2,
                     pipeline_stages=2).train(from_numpy(x, onehot))

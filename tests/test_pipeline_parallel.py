@@ -179,11 +179,12 @@ def test_trainer_pipeline_kwarg_validation():
 
     x, _, onehot = toy_text(n=32)
     df = dk.from_numpy(x, onehot)
-    # fsdp x pipeline is SUPPORTED now (stage-sharded embed/head,
-    # tests/test_pp_fsdp.py); seq_shards x pipeline still rejects
+    # fsdp x pipeline and seq x pipeline are both SUPPORTED now
+    # (tests/test_pp_fsdp.py, tests/test_pp_sp.py) — but seq_shards needs
+    # a ring-attention staged adapter (seq_axis set at construction)
     t = dk.DOWNPOUR(_staged(num_stages=4), pipeline_stages=4, seq_shards=2,
-                    num_workers=2, batch_size=8, num_epoch=1)
-    with pytest.raises(ValueError, match="seq_shards"):
+                    num_workers=1, batch_size=8, num_epoch=1)
+    with pytest.raises(ValueError, match="seq_axis"):
         t.train(df)
     from distkeras_tpu.models import TextCNN
     t2 = dk.DOWNPOUR(FlaxModel(TextCNN(vocab_size=50, num_classes=2)),
