@@ -902,7 +902,14 @@ def run_scaling(config: str = HEADLINE, run_kw: dict = None) -> dict:
         "protocol": PROTOCOL,
     }
     if point_errors:
+        # A sweep with a dead point must not read green at a glance:
+        # surface the failure through the same "error" field _ok_line keys
+        # status on (the contract every emitted line carries).
         out["point_errors"] = point_errors
+        out["error"] = (
+            f"{len(point_errors)} scaling point(s) failed: "
+            + ", ".join(sorted(point_errors, key=int))
+        )
     return out
 
 

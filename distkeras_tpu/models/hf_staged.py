@@ -77,9 +77,15 @@ def _require(cond, msg):
 
 
 def gpt2_to_staged(model, num_stages: int,
-                   blocks_per_stage: Optional[int] = None) -> PretrainedStagedLM:
+                   blocks_per_stage: Optional[int] = None,
+                   seq_axis: Optional[str] = None) -> PretrainedStagedLM:
     """Convert a ``FlaxGPT2LMHeadModel`` (pretrained or fresh) into a
-    pipeline-ready :class:`PretrainedStagedLM`."""
+    pipeline-ready :class:`PretrainedStagedLM`.
+
+    ``seq_axis`` builds the ring-attention variant for pp x sp fine-tuning
+    (``pipeline_stages=S, seq_shards=k``) — set it here rather than via
+    ``dataclasses.replace`` afterwards, which would drop the attached
+    ``_pretrained`` checkpoint (replace builds a fresh instance)."""
     cfg = model.config
     _require(
         type(model).__name__ == "FlaxGPT2LMHeadModel",
@@ -171,6 +177,7 @@ def gpt2_to_staged(model, num_stages: int,
         vocab_size=vocab, dim=dim, heads=heads,
         num_stages=num_stages, blocks_per_stage=blocks_per_stage,
         max_len=int(cfg.n_positions), ln_eps=float(cfg.layer_norm_epsilon),
+        seq_axis=seq_axis,
     )
     staged._pretrained = params
     return staged

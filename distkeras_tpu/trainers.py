@@ -20,6 +20,7 @@ models with trained weights, matching the reference contract.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, List, Optional, Sequence
 
@@ -51,6 +52,33 @@ __all__ = [
     "ADAG",
     "DynSGD",
 ]
+
+
+def _serving_twin(adapter: ModelAdapter) -> ModelAdapter:
+    """The single-device twin of a sequence-parallel adapter (same params).
+
+    A seq_axis-bearing model jit-traces ring-attention collectives and
+    cannot run outside its mesh; every trainer return path hands back the
+    seq_axis=None twin so the reference contract — ``train(df)`` returns a
+    servable model — holds for sp-trained models too.  No-op for adapters
+    without a seq axis."""
+    module = getattr(adapter, "module", None)
+    if module is not None and getattr(module, "seq_axis", None) is not None:
+        from distkeras_tpu.models.adapter import FlaxModel
+
+        return FlaxModel(module.clone(seq_axis=None), adapter.outputs_logits)
+    if (dataclasses.is_dataclass(adapter)
+            and getattr(adapter, "seq_axis", None) is not None):
+        # Staged adapters (pp x sp) are dataclasses, not FlaxModel
+        # wrappers — same twin rule via replace.  replace() builds a
+        # fresh instance, so carry over the non-field checkpoint slot
+        # PretrainedStagedLM's init requires.
+        twin = dataclasses.replace(adapter, seq_axis=None)
+        pretrained = getattr(adapter, "_pretrained", None)
+        if pretrained is not None:
+            twin._pretrained = pretrained
+        return twin
+    return adapter
 
 
 class Trainer:
@@ -591,13 +619,7 @@ class Trainer:
         else:
             params = engine.worker_slice(state.local_params, 0)
         model_state = jax.tree.map(np.asarray, engine.final_model_state(state))
-        # A sequence-parallel model needs a mesh to run; hand back its
-        # single-device twin (same params) so .predict works anywhere.
-        module = getattr(adapter, "module", None)
-        if module is not None and getattr(module, "seq_axis", None) is not None:
-            from distkeras_tpu.models.adapter import FlaxModel
-
-            adapter = FlaxModel(module.clone(seq_axis=None), adapter.outputs_logits)
+        adapter = _serving_twin(adapter)
         if hasattr(adapter, "assign"):  # Keras path: mutate + return the Keras model
             return adapter.assign(params, model_state)
         return TrainedModel(adapter, params, model_state, history=self.history)
@@ -649,6 +671,7 @@ class EnsembleTrainer(Trainer):
             dataframe, worker.rule, self.num_models, shuffle=shuffle
         )
         model_state = jax.tree.map(np.asarray, engine.final_model_state(state))
+        adapter = _serving_twin(adapter)
         return [
             TrainedModel(adapter, engine.worker_slice(state.local_params, i),
                          model_state, history=self.history)

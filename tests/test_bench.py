@@ -402,3 +402,28 @@ def test_deadman_disarm_cancels():
     assert proc.returncode == 0
     assert "survived" in proc.stdout
     assert "hung mid-run" not in proc.stdout
+
+
+def test_scaling_line_reads_error_when_a_point_fails(monkeypatch):
+    """A pod sweep must not read green over a broken point: run_scaling's
+    own contract (its in-loop comment) and _ok_line's at-a-glance verdict.
+    Simulate a 2-process sweep where the k=2 point dies on the measuring
+    process — the emitted line must carry status: error, not ok."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    def fake_run_config(config, num_workers=None, **kw):
+        if num_workers and num_workers > 1:
+            raise RuntimeError("device fault at k=%d" % num_workers)
+        return {"value": 100.0, "chips": 1}
+
+    monkeypatch.setattr(bench, "run_config", fake_run_config)
+    monkeypatch.setattr(jax, "device_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda name: None)
+    out = bench.run_scaling("mnist_mlp_single")
+    assert out["point_errors"] == {"2": "RuntimeError: device fault at k=2"}
+    line = json.loads(bench._ok_line(out))
+    assert line["status"] == "error"
+    assert "scaling point" in line["error"]

@@ -122,3 +122,33 @@ def test_conversion_rejects_mismatched_architectures(hf_model):
     relu_model = transformers.FlaxGPT2LMHeadModel(cfg, seed=0)
     with pytest.raises(ValueError, match="GELU"):
         gpt2_to_staged(relu_model, num_stages=2)
+
+
+def test_pretrained_pp_sp_twin_keeps_checkpoint(hf_model):
+    """gpt2_to_staged(seq_axis=...) fine-tunes under pp x sp, and the
+    TrainedModel _finalize hands back is a fully working adapter: seq_axis
+    dropped (predict runs on a bare device) AND the attached checkpoint
+    carried over — dataclasses.replace alone would lose the non-field
+    ``_pretrained`` slot and a later ``init`` (e.g. continued training
+    through a second trainer) would raise."""
+    import distkeras_tpu as dk
+
+    staged = gpt2_to_staged(hf_model, num_stages=2, seq_axis="seq")
+    rng = np.random.default_rng(4)
+    x = rng.integers(0, 64, size=(64, 8)).astype(np.int32)
+    df = dk.from_numpy(x, x)
+    t = dk.DOWNPOUR(staged, loss="token_crossentropy",
+                    worker_optimizer=("adam", {"learning_rate": 1e-3}),
+                    num_workers=2, batch_size=8, num_epoch=2,
+                    communication_window=2, pipeline_stages=2, seq_shards=2)
+    trained = t.train(df)
+    h = t.get_history()["loss"]
+    assert np.isfinite(h).all() and h[-1] < h[0], h
+    assert trained.adapter.seq_axis is None
+    # the twin still carries the checkpoint: init adopts it (no RuntimeError)
+    params, _ = trained.adapter.init(None, x[:1])
+    emb = np.asarray(params["embed"]["tok_embed"]["embedding"])
+    assert emb.shape == (64, 32) and np.isfinite(emb).all()
+    # ... and predict serves on a bare device
+    out = trained.predict(x[:8])
+    assert np.isfinite(np.asarray(out)).all()

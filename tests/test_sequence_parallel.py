@@ -102,3 +102,22 @@ def test_sp_trained_model_predicts_without_mesh():
                                       output_col="pidx").transform(pred_df)
     pred_df = pred_df.with_column("y", y)
     assert dk.AccuracyEvaluator(prediction_col="pidx", label_col="y").evaluate(pred_df) == acc
+
+
+def test_sp_ensemble_models_predict_without_mesh():
+    """EnsembleTrainer returns N models; each must be servable as returned
+    — the same seq_axis=None twin rule as every other trainer return path
+    (a seq_axis-bearing adapter would trace ring collectives outside any
+    mesh and raise on .predict)."""
+    x, y, onehot = toy_text(n=128, seq=32)
+    df = from_numpy(x, onehot)
+    t = dk.EnsembleTrainer(_model("seq"), loss="categorical_crossentropy",
+                           worker_optimizer=("adam", {"learning_rate": 3e-3}),
+                           batch_size=16, num_epoch=4, num_models=2,
+                           seq_shards=2)
+    models = t.train(df)
+    assert len(models) == 2
+    for m in models:
+        assert m.adapter.module.seq_axis is None
+        preds = m.predict(x)
+        assert preds.shape == (128, 2)
