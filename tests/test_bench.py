@@ -417,7 +417,10 @@ def test_scaling_line_reads_error_when_a_point_fails(monkeypatch):
             raise RuntimeError("device fault at k=%d" % num_workers)
         return {"value": 100.0, "chips": 1}
 
+    joins = []
     monkeypatch.setattr(bench, "run_config", fake_run_config)
+    monkeypatch.setattr(bench, "_join_reps_broadcast",
+                        lambda: joins.append(1))
     monkeypatch.setattr(jax, "device_count", lambda: 2)
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     monkeypatch.setattr(multihost_utils, "sync_global_devices",
@@ -427,3 +430,6 @@ def test_scaling_line_reads_error_when_a_point_fails(monkeypatch):
     line = json.loads(bench._ok_line(out))
     assert line["status"] == "error"
     assert "scaling point" in line["error"]
+    # the pre-calibration failure joined the owners' global reps broadcast
+    # (sub-mesh deadlock guard) exactly once
+    assert joins == [1]

@@ -225,3 +225,24 @@ def test_pipeline_remat_trajectory_identical():
     for a, b in zip(jax.tree.leaves(center_r), jax.tree.leaves(center)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_gather_center_program_is_cached():
+    """gather_center re-replicates through ONE cached jitted identity — a
+    fresh jit(lambda) per call misses the function cache and re-traces on
+    every checkpoint save / _finalize (the per-call-closure trap the
+    windowed engine documents at engine.py::gather_center)."""
+    x, _, onehot = toy_text()
+    eng = PipelineEngine(_staged(num_stages=2), "categorical_crossentropy",
+                         ("sgd", {"learning_rate": 0.05}), Downpour(2),
+                         num_workers=4, microbatches=2)
+    xs, ys = epoch_data(x, onehot, num_workers=4, window=2, n_windows=1,
+                        batch=8)
+    state = eng.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
+    first = eng.gather_center(state)
+    prog = eng._fsdp_regather
+    assert prog is not None
+    second = eng.gather_center(state)
+    assert eng._fsdp_regather is prog  # same compiled program, no retrace
+    for a, b in zip(jax.tree.leaves(first), jax.tree.leaves(second)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
