@@ -326,7 +326,15 @@ def to_spark(df: DataFrame, spark, columns: Sequence[str] | None = None):
     def pyify(name):
         col = df.column(name)
         if col.dtype == object or col.ndim > 1:
-            return [np.asarray(v).ravel().astype(float).tolist() for v in col]
+            try:
+                return [np.asarray(v).ravel().astype(float).tolist()
+                        for v in col]
+            except (ValueError, TypeError):
+                # non-numeric object column (strings, ids — ubiquitous in
+                # Spark frames): pass the rows through as Python scalars
+                # like the scalar branch does, don't force-cast to float
+                return [v.item() if isinstance(v, np.generic) else v
+                        for v in col]
         return col.tolist()
 
     data = {name: pyify(name) for name in names}

@@ -170,3 +170,18 @@ def test_from_spark_real_pyspark_roundtrip():
         assert list(df.column("label")) == [0, 1]
     finally:
         spark.stop()
+
+
+def test_to_spark_passes_string_columns_through():
+    """Egress must not force-cast non-numeric object columns: a Spark frame
+    routinely carries string columns (ids, raw text) alongside the numeric
+    ones, and astype(float) on them raised ValueError — the round trip
+    failed on exactly the frames Spark users actually have."""
+    df = from_spark(_FakeSparkDF(_rows(8)))
+    df = df.with_column("doc_id", np.array([f"doc-{i}" for i in range(8)],
+                                           dtype=object))
+    spark = _FakeSparkSession()
+    _, received = dk.to_spark(df, spark, columns=["features", "doc_id"])
+    assert received["doc_id"].tolist() == [f"doc-{i}" for i in range(8)]
+    feats = received["features"][0]
+    assert isinstance(feats, list) and all(isinstance(v, float) for v in feats)

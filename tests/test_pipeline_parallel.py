@@ -246,3 +246,49 @@ def test_gather_center_program_is_cached():
     assert eng._fsdp_regather is prog  # same compiled program, no retrace
     for a, b in zip(jax.tree.leaves(first), jax.tree.leaves(second)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pipeline_respects_declared_head_form():
+    """A staged adapter declaring outputs_logits=False (softmax-head
+    protocol) must train against the probability-form loss: the pipelined
+    view forwards the wrapped adapter's flag instead of defaulting to
+    True, which would silently apply from_logits crossentropy to
+    probability outputs (while the same adapter paired correctly with the
+    windowed engine)."""
+    import dataclasses as dc
+
+    import optax
+
+    x, _, onehot = toy_text()
+    base = _staged(num_stages=2)
+
+    def loss_at_init(adapter):
+        eng = PipelineEngine(adapter, "categorical_crossentropy",
+                             ("sgd", {"learning_rate": 0.0}), Downpour(2),
+                             num_workers=4, microbatches=2)
+        assert eng.adapter.outputs_logits == adapter.outputs_logits
+        xs, ys = epoch_data(x, onehot, num_workers=4, window=2,
+                            n_windows=1, batch=8)
+        xs_d, ys_d = eng.shard_batches(xs, ys)
+        state = eng.init_state(jax.random.PRNGKey(0), xs[0, 0, 0])
+        params_np = jax.tree.map(np.asarray, eng.gather_center(state))
+        _, stats = eng.run_epoch(state, xs_d, ys_d)
+        flat_x = xs.reshape(-1, xs.shape[-1])
+        flat_y = ys.reshape(-1, ys.shape[-1])
+        return float(np.asarray(stats["loss"]).mean()), params_np, flat_x, flat_y
+
+    l_logits, params_np, flat_x, flat_y = loss_at_init(base)
+    l_probs, _, _, _ = loss_at_init(dc.replace(base, outputs_logits=False))
+    # same outputs, two declared head forms -> two different objectives
+    assert abs(l_logits - l_probs) > 1e-3, (l_logits, l_probs)
+    # and each matches its closed form on the raw (sequential) outputs of
+    # the exact epoch rows
+    outs, _ = base.apply(params_np, {}, flat_x)
+    outs = np.asarray(outs, np.float32)
+    want_logits = float(optax.softmax_cross_entropy(outs, flat_y).mean())
+    p = np.clip(outs, 1e-7, 1 - 1e-7)
+    want_probs = float(-(flat_y * np.log(p)).sum(-1).mean())
+    assert abs(l_logits - want_logits) < 0.02 * max(1.0, want_logits), (
+        l_logits, want_logits)
+    assert abs(l_probs - want_probs) < 0.02 * max(1.0, want_probs), (
+        l_probs, want_probs)
