@@ -416,8 +416,8 @@ def preflight(max_tries: int = 6, init_timeout: float = 120.0, retry_sleep: floa
         if not transient or attempt == max_tries - 1:
             return {"error": last}
         time.sleep(retry_sleep)
-    else:
-        return {"error": last}
+    # (no for/else: every iteration either breaks on a good probe or
+    # returns on the last attempt — exhaustion is the early return above)
 
     result = {}
 

@@ -73,17 +73,24 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
     return path
 
 
-def latest_step(directory: str) -> Optional[int]:
-    wait_until_finished()  # a step only counts once its async save committed
+def committed_steps(directory: str) -> list:
+    """Steps whose final ``step_<n>`` directory exists — async saves only
+    get their final name at commit, so the listing alone is a commit
+    record (no flush needed)."""
     directory = os.path.abspath(directory)
     if not os.path.isdir(directory):
-        return None
-    steps = [
+        return []
+    return sorted(
         int(d.split("_", 1)[1])
         for d in os.listdir(directory)
         if d.startswith("step_") and d.split("_", 1)[1].isdigit()
-    ]
-    return max(steps) if steps else None
+    )
+
+
+def latest_step(directory: str) -> Optional[int]:
+    wait_until_finished()  # a step only counts once its async save committed
+    steps = committed_steps(directory)
+    return steps[-1] if steps else None
 
 
 def restore_checkpoint(directory: str, step: Optional[int] = None, like: Any = None) -> Any:
@@ -275,26 +282,28 @@ class CheckpointManager:
     def wait(self) -> None:
         """Flush in-flight async saves (end of the trainer epoch loop)."""
         wait_until_finished()
+        # everything initiated is now committed: apply the keep policy
+        # exactly (collects the predecessor whose deletion _gc deferred
+        # while its successor was in flight)
+        self._gc()
 
     def _gc(self) -> None:
-        # The newest save may still be in flight and not yet on disk, so gc
-        # works from the union of the directory listing and the steps this
-        # manager initiated; the in-flight step is always the newest and
-        # keep >= 1 protects it.  Older steps are fully committed (the async
-        # checkpointer serialises saves), so removing them is safe.
-        on_disk = {
-            int(d.split("_", 1)[1])
-            for d in os.listdir(self.directory)
-            if d.startswith("step_") and d.split("_", 1)[1].isdigit()
-        }
-        steps = sorted(on_disk | self._saved)
+        # Only COMMITTED steps (final step_ dirs on disk) are gc
+        # candidates.  Counting the in-flight newest save toward ``keep``
+        # would, at keep=1, delete the only committed checkpoint while the
+        # new one is still writing — a crash in that window leaves zero
+        # restorable checkpoints.  The in-flight step has no final dir yet,
+        # so excluding it both protects it and defers deleting its
+        # predecessor until it lands (at most one extra step on disk).
         import shutil
 
-        for s in steps[: -self.keep] if self.keep else []:
+        committed = committed_steps(self.directory)
+        for s in committed[: -self.keep] if self.keep else []:
             self._saved.discard(s)
             shutil.rmtree(os.path.join(self.directory, f"step_{s}"), ignore_errors=True)
 
     def latest(self) -> Optional[int]:
+        self.wait()  # flush + exact keep policy before reading the record
         return latest_step(self.directory)
 
     def saved_worker_count(self, step: Optional[int] = None) -> int:

@@ -66,12 +66,20 @@ class ModelPredictor(Predictor):
             else:
                 self.params = None  # lazy: init from the first real batch
                 self.state = {}
-        self.mesh = make_mesh(num_devices)
+        # LOCAL devices only: prediction is per-process data parallel (each
+        # process holds its own frame rows, like the reference's
+        # mapPartitions executors).  A global mesh would hand device_put
+        # non-addressable shardings and make the output un-gatherable on
+        # multi-host runs.
+        self.mesh = make_mesh(num_devices, devices=jax.local_devices())
         self.n_dev = int(self.mesh.devices.size)
-        # Below this many rows the mesh path isn't worth the put/gather.
+        # Below this many rows the mesh path isn't worth the put/gather —
+        # scaled by the device count so distribution kicks in only when
+        # every chip gets a meaningful slice of work (a bare batch_size
+        # would widen one batch n_dev-fold and pad it with duplicates).
         self.distribute_threshold = (
             int(distribute_threshold) if distribute_threshold is not None
-            else self.batch_size
+            else self.batch_size * self.n_dev
         )
         self._rep = replicated_sharding(self.mesh)
         self._shard = worker_sharding(self.mesh)

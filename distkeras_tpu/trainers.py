@@ -774,7 +774,7 @@ class DistributedTrainer(Trainer):
         """
         if not self.checkpoint_dir:
             raise ValueError("train_with_recovery requires checkpoint_dir")
-        from distkeras_tpu.checkpoint import latest_step
+        from distkeras_tpu.checkpoint import committed_steps, latest_step
 
         attempts = 0
         last_failure = None
@@ -784,7 +784,17 @@ class DistributedTrainer(Trainer):
                 return self.train(dataframe, shuffle)
             except Exception as e:  # noqa: BLE001 — re-raised unless retryable
                 failure = (type(e), str(e))
-                step = latest_step(self.checkpoint_dir)
+                try:
+                    step = latest_step(self.checkpoint_dir)
+                except Exception:  # noqa: BLE001 — see below
+                    # latest_step flushes in-flight async saves, so a save
+                    # that failed in the background re-raises HERE — it
+                    # must not mask the training error we're handling or
+                    # bypass the retry.  Fall back to the committed
+                    # directory listing (final step_ names only appear
+                    # after commit, so no flush is needed for those).
+                    on_disk = committed_steps(self.checkpoint_dir)
+                    step = on_disk[-1] if on_disk else None
                 if step != last_step:
                     # checkpointed progress since the previous failure: a
                     # repeating signature is a recurring *transient* (e.g.

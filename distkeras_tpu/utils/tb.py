@@ -30,12 +30,16 @@ class ScalarLogger:
             self._writer = SummaryWriter(self.logdir)
             self._write = self._write_torch
         except Exception:
-            try:
+            if os.environ.get("DISTKERAS_TB_TF"):
+                # Opt-in only: initializing TensorFlow inside the live
+                # training process can preallocate accelerator memory /
+                # contend for libtpu — too big a side effect for a scalar
+                # logger to take on by default.
                 import tensorflow as tf
 
                 self._writer = tf.summary.create_file_writer(self.logdir)
                 self._write = self._write_tf
-            except Exception:
+            else:
                 self._jsonl = open(os.path.join(self.logdir, "scalars.jsonl"), "a")
 
     def _write_torch(self, step, scalars):

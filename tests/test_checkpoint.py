@@ -90,3 +90,26 @@ def test_pipeline_resume_matches_uninterrupted(tmp_path):
     for a, b in zip(jax.tree.leaves(straight.params),
                     jax.tree.leaves(resumed.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gc_never_deletes_the_only_committed_step(tmp_path):
+    """keep=1 with an async save in flight: the in-flight step must not
+    count toward `keep`, or _gc deletes the only committed checkpoint and
+    a crash during the in-flight save leaves nothing restorable."""
+    import os
+
+    mgr = CheckpointManager(str(tmp_path), every=1, keep=1)
+    state = {"x": np.zeros(2)}
+    mgr.maybe_save(state, 0)
+    mgr.wait()  # step_1 committed
+    assert sorted(d for d in os.listdir(tmp_path) if d.startswith("step_")) == ["step_1"]
+    # simulate step 2 in flight: initiated (in _saved) but no final dir yet
+    mgr._saved.add(2)
+    mgr._gc()
+    assert sorted(d for d in os.listdir(tmp_path) if d.startswith("step_")) == ["step_1"], (
+        "in-flight step must not evict the only committed checkpoint"
+    )
+    # once step 2 commits (final dir lands), the predecessor is collectable
+    os.makedirs(tmp_path / "step_2")
+    mgr._gc()
+    assert sorted(d for d in os.listdir(tmp_path) if d.startswith("step_")) == ["step_2"]

@@ -65,3 +65,26 @@ def test_recovery_requires_checkpoint_dir(toy_classification):
     t = dk.DOWNPOUR(FlaxModel(MLP(features=(8,), num_classes=2)), num_workers=2)
     with pytest.raises(ValueError, match="checkpoint_dir"):
         t.train_with_recovery(df)
+
+
+def test_failed_async_save_does_not_mask_the_training_error(
+        toy_classification, tmp_path, monkeypatch):
+    """latest_step() flushes in-flight async saves, so a background save
+    failure re-raises inside train_with_recovery's except handler — it
+    must not replace the training error or bypass the retry decision
+    (the handler falls back to the committed directory listing)."""
+    import distkeras_tpu.checkpoint as ckpt
+
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    monkeypatch.setattr(WindowedEngine, "run_epoch",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("training boom")))
+    monkeypatch.setattr(ckpt, "latest_step",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            OSError("async save failed")))
+    t = _trainer(tmp_path)
+    # the TRAINING error surfaces (no committed checkpoint -> no retry);
+    # the checkpoint error must not shadow it
+    with pytest.raises(RuntimeError, match="training boom"):
+        t.train_with_recovery(df, max_retries=2)
