@@ -52,15 +52,16 @@ class PunchcardServer:
         self._sock.bind(("0.0.0.0", self.port))
         self.port = self._sock.getsockname()[1]
         self._sock.listen(16)
-        self._running = True
+        with self._cv:
+            self._running = True
         for target in (self._accept_loop, self._runner_loop):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
 
     def stop(self) -> None:
-        self._running = False
         with self._cv:
+            self._running = False
             self._cv.notify_all()
         if self._sock is not None:
             try:  # self-connect to unblock accept() — the reference's cancel_accept trick
@@ -93,9 +94,11 @@ class PunchcardServer:
             action = msg.get("action")
             if action == "submit":
                 job_id = uuid.uuid4().hex
-                self.jobs[job_id] = {"status": "queued", "output": "", "returncode": None,
-                                     "script": msg["script"], "args": msg.get("args", [])}
                 with self._cv:
+                    self.jobs[job_id] = {"status": "queued", "output": "",
+                                         "returncode": None,
+                                         "script": msg["script"],
+                                         "args": msg.get("args", [])}
                     self._queue.append(job_id)
                     self._cv.notify()
                 send_data(conn, {"status": "queued", "job_id": job_id})

@@ -34,6 +34,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from distkeras_tpu.utils.compat import shard_map
 from jax import lax
 
 __all__ = ["greedy_generate", "greedy_generate_staged_pipelined"]
@@ -295,7 +297,7 @@ def greedy_generate_staged_pipelined(
             (_, _), rest = lax.scan(body, (cache, tok), positions)
             return jnp.moveaxis(jnp.concatenate([tok[None], rest], axis=0), 0, 1)
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             run,
             mesh=mesh,
             in_specs=(

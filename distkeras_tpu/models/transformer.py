@@ -16,6 +16,8 @@ from typing import Optional
 
 import flax.linen as nn
 import jax
+
+from distkeras_tpu.utils.compat import axis_size
 import jax.numpy as jnp
 from jax import lax
 
@@ -204,7 +206,7 @@ class TransformerClassifier(nn.Module):
     def __call__(self, tokens, training: bool = False):
         block_len = tokens.shape[1]
         seq_total = (
-            block_len * lax.axis_size(self.seq_axis)
+            block_len * axis_size(self.seq_axis)
             if self.seq_axis is not None else block_len
         )
         x = _encode_tokens(

@@ -47,6 +47,7 @@ from distkeras_tpu.parallel.mesh import (
     replicated_sharding,
     worker_sharding,
 )
+from distkeras_tpu.utils.compat import shard_map
 from distkeras_tpu.utils.pytree import tree_cast, tree_where
 
 __all__ = ["TrainState", "WindowedEngine", "plan_workers",
@@ -74,7 +75,7 @@ def init_on_mesh(adapter, rng, sample_input, mesh, seq_axis: str):
     and the pipeline engine's sp paths use."""
     sample = jnp.asarray(sample_input)
     spec = P(*([None] * (sample.ndim - 1)), seq_axis)
-    return jax.shard_map(
+    return shard_map(
         lambda smp: adapter.init(rng, smp),
         mesh=mesh, in_specs=(spec,), out_specs=P(), check_vma=False,
     )(sample)
@@ -138,6 +139,8 @@ class WindowedEngine:
     _fsdp_seq: bool = False
     _center_fsdp_dims = None
     _fsdp_regather = None
+    _avg_fn = None
+    _final_ms_fn = None
     fsdp: bool = False
 
     def __init__(
@@ -613,7 +616,7 @@ class WindowedEngine:
         xs_spec, ys_spec = self._data_specs(xs_ndim)
         center_spec, center_rule_spec = self._center_in_specs()
         local_spec = self._local_in_spec()
-        mapped = jax.shard_map(
+        mapped = shard_map(
             worker_fn,
             mesh=self.mesh,
             in_specs=(center_spec, center_rule_spec, local_spec, xs_spec, ys_spec),
@@ -782,7 +785,7 @@ class WindowedEngine:
         xs_spec, ys_spec = self._data_specs(xs_ndim)
         center_spec, center_rule_spec = self._center_in_specs()
         local_spec = self._local_in_spec()
-        mapped = jax.shard_map(
+        mapped = shard_map(
             worker_fn,
             mesh=self.mesh,
             in_specs=(center_spec, center_rule_spec, local_spec, xs_spec, ys_spec,
@@ -970,22 +973,30 @@ class WindowedEngine:
     def average_workers(self, state: TrainState):
         """One-shot synchronous weight average (AveragingTrainer's final step)."""
 
-        def _avg(state):
-            mean_p = jax.tree.map(lambda x: jnp.mean(x, axis=0), state.local_params)
-            mean_ms = jax.tree.map(lambda x: jnp.mean(x, axis=0), state.model_state)
-            return state.replace(center_params=mean_p), mean_ms
+        # cached program: a fresh jit wrapper per call would re-trace every
+        # time (same per-call-closure trap as _fsdp_regather below)
+        if self._avg_fn is None:
+            def _avg(state):
+                mean_p = jax.tree.map(
+                    lambda x: jnp.mean(x, axis=0), state.local_params)
+                mean_ms = jax.tree.map(
+                    lambda x: jnp.mean(x, axis=0), state.model_state)
+                return state.replace(center_params=mean_p), mean_ms
 
+            self._avg_fn = jax.jit(_avg, out_shardings=(None, self._rep))
         with self.mesh:
-            new_state, mean_ms = jax.jit(_avg, out_shardings=(None, self._rep))(state)
+            new_state, mean_ms = self._avg_fn(state)
         return new_state, mean_ms
 
     def final_model_state(self, state: TrainState):
         """Replicated model state for the returned model (mean of workers)."""
-        with self.mesh:
-            return jax.jit(
+        if self._final_ms_fn is None:
+            self._final_ms_fn = jax.jit(
                 lambda ms: jax.tree.map(lambda x: jnp.mean(x, axis=0), ms),
                 out_shardings=self._rep,
-            )(state.model_state)
+            )
+        with self.mesh:
+            return self._final_ms_fn(state.model_state)
 
     def worker_slice(self, tree, index: int):
         """Fetch one worker's slice of per-worker state to host (Ensemble path)."""

@@ -86,6 +86,9 @@ class GSPMDEngine(WindowedEngine):
     mesh.  Same public surface as :class:`WindowedEngine` (``init_state``,
     ``run_epoch``, ``shard_batches``, ``average_workers``, ...)."""
 
+    _regather_fn = None
+    _slice_fn = None
+
     def __init__(
         self,
         adapter: ModelAdapter,
@@ -379,15 +382,23 @@ class GSPMDEngine(WindowedEngine):
     def gather_center(self, state: TrainState):
         """Re-replicate the model-axis-sharded center leaves so every host
         process can ``np.asarray`` them (trainer finalisation, PS attach)."""
+        # cached programs: a fresh jit wrapper per call would re-trace on
+        # every checkpoint save / finalisation (per-call-closure trap)
+        if self._regather_fn is None:
+            self._regather_fn = jax.jit(lambda t: t, out_shardings=self._rep)
         with self.mesh:
-            return jax.jit(lambda t: t, out_shardings=self._rep)(state.center_params)
+            return self._regather_fn(state.center_params)
 
     def worker_slice(self, tree, index: int):
-        with self.mesh:
-            sliced = jax.jit(
-                lambda t: jax.tree.map(lambda x: x[index], t),
+        # index rides along as a traced operand so one compiled program
+        # serves every worker slot (a closed-over index would retrace per i)
+        if self._slice_fn is None:
+            self._slice_fn = jax.jit(
+                lambda t, i: jax.tree.map(lambda x: x[i], t),
                 out_shardings=self._rep,
-            )(tree)
+            )
+        with self.mesh:
+            sliced = self._slice_fn(tree, index)
         return jax.tree.map(np.asarray, sliced)
 
     # --------------------------------------------------------------- sharding

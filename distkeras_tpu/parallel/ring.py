@@ -25,6 +25,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from distkeras_tpu.utils.compat import axis_size, shard_map
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -94,7 +96,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     device's Q block, exactly equal (up to float assoc.) to full attention
     over the gathered sequence.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     qt = jnp.moveaxis(q, 1, 2)  # [b,h,lq,d]
     kt = jnp.moveaxis(k, 1, 2)
@@ -139,7 +141,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: Optional[str] = None,
     ``shard_map``."""
     axis_name = axis_name or mesh.axis_names[0]
     spec = P(None, axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -195,10 +195,15 @@ class Trainer:
 
     # -- wall-clock bookkeeping (reference parity) --------------------------
     def record_training_start(self) -> None:
-        self._t0 = time.time()
+        # monotonic clock: wall-clock (time.time) can jump under NTP slew,
+        # yielding negative or wildly wrong durations
+        self._t0 = time.perf_counter()
 
     def record_training_stop(self) -> None:
-        self.training_time = time.time() - (self._t0 or time.time())
+        if self._t0 is None:  # stop without start: no interval to measure
+            self.training_time = 0.0
+        else:
+            self.training_time = time.perf_counter() - self._t0
 
     def get_training_time(self) -> float:
         return self.training_time

@@ -17,7 +17,15 @@ os.environ.setdefault("KERAS_BACKEND", "jax")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5): the config option doesn't exist; the XLA flag does
+    # the same thing as long as it lands before the first backend query.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 
 import numpy as np
 import pytest

@@ -1,0 +1,40 @@
+"""DK101 fixture: host syncs inside hot (traced) functions.
+
+Never imported — parsed only.  Line numbers are asserted by
+tests/test_lint.py; keep edits append-only or update the test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def jitted_step(params, x):
+    loss = jnp.mean(x)
+    bad = loss.item()  # line 17: DK101 .item()
+    arr = np.asarray(x)  # line 18: DK101 np.asarray
+    scale = float(x)  # line 19: DK101 float() on traced arg
+    host = jax.device_get(params)  # line 20: DK101 device_get
+    ok = loss.item()  # dklint: disable=DK101  (line 21: suppressed)
+    return bad, arr, scale, host, ok
+
+
+def scanned_body(carry, batch):
+    jax.block_until_ready(carry)  # line 26: DK101 — body is passed to lax.scan
+    return carry, batch
+
+
+def run(xs):
+    return lax.scan(scanned_body, 0.0, xs)
+
+
+class ToyEngine:
+    def _local_step(self, carry, batch):
+        window = 4
+        w = float(window)  # closure/local int: NOT flagged
+        return carry, batch[0].item()  # line 37: DK101 — engine hot method
+
+    def cold_path(self, stats):
+        return np.asarray(stats)  # host-side helper: NOT flagged

@@ -1,0 +1,54 @@
+"""DK102 fixture: recompilation hazards.  Parsed only, never imported."""
+
+import jax
+import jax.numpy as jnp
+
+
+def per_call_wrapper(state, xs):
+    return jax.jit(lambda s, x: s + x)(state, xs)  # line 8: DK102 immediate invocation
+
+
+def suppressed_wrapper(state, xs):
+    return jax.jit(lambda s, x: s + x)(state, xs)  # dklint: disable=DK102
+
+
+def jit_in_loop(batches):
+    out = []
+    for b in batches:
+        f = jax.jit(jnp.sum)  # line 17: DK102 jit in loop
+        out.append(f(b))
+    return out
+
+
+@jax.jit
+def python_control_flow(x, flag):
+    if flag:  # line 24: DK102 traced arg in branch
+        x = x + 1
+    for _ in range(3):  # literal bound: NOT flagged
+        x = x * 2
+    return x
+
+
+@jax.jit
+def loop_bound(x, n):
+    for _ in range(n):  # line 33: DK102 traced arg as range() bound
+        x = x + 1
+    return x
+
+
+@jax.jit
+def static_ok(x, n):  # handled via static_argnames: NOT flagged
+    return x
+
+
+static_ok = jax.jit(static_ok, static_argnames=("n",))
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(1,))
+def static_positional(x, n):
+    for _ in range(n):  # static: NOT flagged
+        x = x + 1
+    return x

@@ -1,0 +1,27 @@
+"""DK103 fixture: donated buffers read after the donating call.  Parsed only."""
+
+import jax
+
+
+def read_after_donate(step_fn, state, xs):
+    epoch_fn = jax.jit(step_fn, donate_argnums=(0,))
+    new_state, stats = epoch_fn(state, xs)
+    loss = state.loss  # line 9: DK103 'state' donated on line 8
+    return new_state, loss
+
+
+def rebind_is_fine(step_fn, state, xs):
+    epoch_fn = jax.jit(step_fn, donate_argnums=(0,))
+    state, stats = epoch_fn(state, xs)  # rebind on the call line: NOT flagged
+    return state.loss, stats
+
+
+def immediate_donate(step_fn, state, xs):
+    out = jax.jit(step_fn, donate_argnums=(0,))(state, xs)
+    return state, out  # line 21: DK103 'state' donated on line 20
+
+
+def suppressed(step_fn, state, xs):
+    epoch_fn = jax.jit(step_fn, donate_argnums=(0,))
+    new_state = epoch_fn(state, xs)
+    return state, new_state  # dklint: disable=DK103

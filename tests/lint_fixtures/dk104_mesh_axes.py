@@ -1,0 +1,27 @@
+"""DK104 fixture: collective axis names vs declared mesh axes.  Parsed only."""
+
+import jax
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+WORKER_AXIS = "workers"
+
+mesh = Mesh(np.array(jax.devices()), ("workers", "seq"))
+
+
+def good(x):
+    a = lax.psum(x, WORKER_AXIS)  # declared via constant: NOT flagged
+    b = lax.pmean(x, "seq")  # declared via Mesh(...) literal: NOT flagged
+    return a, b
+
+
+def bad(x):
+    a = lax.psum(x, "worker")  # line 20: DK104 typo'd axis
+    b = lax.all_gather(x, "stagess", axis=0, tiled=True)  # line 21: DK104
+    i = lax.axis_index("sequence")  # line 22: DK104
+    return a, b, i
+
+
+def suppressed(x):
+    return lax.psum(x, "workerz")  # dklint: disable=DK104

@@ -1,0 +1,39 @@
+"""DK105 fixture: guarded attributes written off-lock.  Parsed only."""
+
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._queue = []
+        self.running = False  # __init__ writes are exempt
+        self.stats = {}
+
+    def start(self):
+        self.running = True  # line 14: DK105 — 'running' is read under _cv
+
+    def stop(self):
+        self.running = False  # dklint: disable=DK105  (line 17: suppressed)
+        with self._cv:
+            self._cv.notify_all()
+
+    def submit(self, item):
+        self._queue.append(item)  # line 22: DK105 — '_queue' mutated off-lock
+
+    def run_loop(self):
+        with self._cv:
+            while self.running and not self._queue:
+                self._cv.wait()
+            self._queue.pop(0)
+
+    def untracked(self):
+        self.stats["x"] = 1  # never touched under the lock: NOT flagged
+
+
+class NoLocks:
+    def __init__(self):
+        self.x = 0
+
+    def bump(self):
+        self.x += 1  # class owns no lock: NOT flagged

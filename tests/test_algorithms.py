@@ -1,6 +1,8 @@
 """Closed-form unit tests for the update rules (SURVEY.md §3.3 math)."""
 
 import jax
+
+from distkeras_tpu.utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -118,7 +120,7 @@ def test_multi_worker_psum_commit(rule_cls):
         res = rule.commit(ctx, local, center, rule.init_local_state(center), rule.init_center_state())
         return res.center_params["w"].reshape(1)
 
-    f = jax.shard_map(worker, mesh=mesh, in_specs=P("workers"), out_specs=P("workers"),
+    f = shard_map(worker, mesh=mesh, in_specs=P("workers"), out_specs=P("workers"),
                       check_vma=False)
     out = np.asarray(f(jnp.asarray([0.5, 0.25], jnp.float32)))
     # both workers agree on the center: 0.5 + 0.25 (scaled 1 for staleness 0 / window 1)
@@ -135,7 +137,7 @@ def test_oneshot_average():
         res = rule.commit(ctx, local, {"w": jnp.zeros(())}, (), rule.init_center_state())
         return res.center_params["w"].reshape(1)
 
-    f = jax.shard_map(worker, mesh=mesh, in_specs=P("workers"), out_specs=P("workers"),
+    f = shard_map(worker, mesh=mesh, in_specs=P("workers"), out_specs=P("workers"),
                       check_vma=False)
     out = np.asarray(f(jnp.asarray([1.0, 2.0, 3.0, 4.0], jnp.float32)))
     np.testing.assert_allclose(out, [2.5] * 4)

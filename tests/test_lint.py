@@ -1,0 +1,213 @@
+"""dklint self-tests: fixture firing, suppressions, baseline, and the
+package-wide gate (distkeras_tpu/ must be clean modulo the committed
+baseline).  Pure AST work — no jax import, no devices."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "lint_fixtures")
+BASELINE = os.path.join(REPO_ROOT, "tools", "dklint", "baseline.json")
+
+sys.path.insert(0, REPO_ROOT)
+
+from tools.dklint import analyze, apply_baseline, load_baseline  # noqa: E402
+from tools.dklint.registry import all_rules  # noqa: E402
+
+
+def _run(fixture, select):
+    path = os.path.join(FIXTURES, fixture)
+    findings, files = analyze([path], root=REPO_ROOT, select=select)
+    return [(f.rule, f.line) for f in findings], files
+
+
+# --------------------------------------------------------------- per-rule
+
+def test_dk101_host_sync_fixture():
+    got, _ = _run("dk101_host_sync.py", ["DK101"])
+    assert got == [
+        ("DK101", 16),  # .item() in jitted fn
+        ("DK101", 17),  # np.asarray in jitted fn
+        ("DK101", 18),  # float() on traced arg
+        ("DK101", 19),  # jax.device_get
+        ("DK101", 25),  # block_until_ready in scan body
+        ("DK101", 37),  # .item() in engine hot method
+    ]
+
+
+def test_dk101_suppression_and_cold_paths():
+    got, _ = _run("dk101_host_sync.py", ["DK101"])
+    lines = [ln for _, ln in got]
+    assert 20 not in lines  # trailing `# dklint: disable=DK101`
+    assert 36 not in lines  # float() on a local int, not a traced arg
+    assert 40 not in lines  # np.asarray outside any hot path
+
+
+def test_dk102_recompile_fixture():
+    got, _ = _run("dk102_recompile.py", ["DK102"])
+    assert got == [
+        ("DK102", 8),   # jax.jit(...)(...) immediate invocation
+        ("DK102", 18),  # jit construction inside a for loop
+        ("DK102", 25),  # traced arg as branch condition
+        ("DK102", 34),  # traced arg as range() bound
+    ]
+
+
+def test_dk102_suppression_and_statics():
+    got, _ = _run("dk102_recompile.py", ["DK102"])
+    lines = [ln for _, ln in got]
+    assert 12 not in lines  # suppressed immediate invocation
+    assert 27 not in lines  # literal range bound
+    assert 52 not in lines  # static_argnums-covered range bound
+
+
+def test_dk103_donation_fixture():
+    got, _ = _run("dk103_donation.py", ["DK103"])
+    assert got == [
+        ("DK103", 9),   # state.loss read after donating call
+        ("DK103", 21),  # read after immediate donate-invocation
+    ]
+
+
+def test_dk103_rebind_and_suppression():
+    got, _ = _run("dk103_donation.py", ["DK103"])
+    lines = [ln for _, ln in got]
+    assert 15 not in lines  # rebound on the call line
+    assert 16 not in lines  # use after rebind is the blessed idiom
+    assert 27 not in lines  # suppressed
+
+
+def test_dk104_mesh_axes_fixture():
+    got, _ = _run("dk104_mesh_axes.py", ["DK104"])
+    assert got == [
+        ("DK104", 20),  # psum over typo'd axis
+        ("DK104", 21),  # all_gather over unknown axis
+        ("DK104", 22),  # axis_index over unknown axis
+    ]
+
+
+def test_dk104_declared_axes_and_suppression():
+    got, _ = _run("dk104_mesh_axes.py", ["DK104"])
+    lines = [ln for _, ln in got]
+    assert 14 not in lines  # *_AXIS constant counts as declared
+    assert 15 not in lines  # Mesh(..., ("workers", "seq")) literal counts
+    assert 27 not in lines  # suppressed
+
+
+def test_dk105_locks_fixture():
+    got, _ = _run("dk105_locks.py", ["DK105"])
+    assert got == [
+        ("DK105", 14),  # guarded attr written off-lock
+        ("DK105", 22),  # guarded list mutated off-lock
+    ]
+
+
+def test_dk105_exemptions_and_suppression():
+    got, _ = _run("dk105_locks.py", ["DK105"])
+    lines = [ln for _, ln in got]
+    assert 10 not in lines  # __init__ writes exempt
+    assert 17 not in lines  # suppressed
+    assert 31 not in lines  # attr never touched under the lock
+    assert 39 not in lines  # class owns no lock
+
+
+# ------------------------------------------------------------ machinery
+
+def test_file_wide_suppression(tmp_path):
+    src = (
+        "# dklint: disable=DK102\n"
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.jit(lambda v: v)(x)\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, _ = analyze([str(p)], root=str(tmp_path), select=["DK102"])
+    assert findings == []
+
+
+def test_disable_all(tmp_path):
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.jit(lambda v: v)(x)  # dklint: disable=all\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, _ = analyze([str(p)], root=str(tmp_path), select=["DK102"])
+    assert findings == []
+
+
+def test_baseline_cancels_and_reports_stale(tmp_path):
+    src = "import jax\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n"
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, files = analyze([str(p)], root=str(tmp_path), select=["DK102"])
+    assert len(findings) == 1
+    entry = {"path": "mod.py", "rule": "DK102",
+             "text": "return jax.jit(lambda v: v)(x)", "reason": "test"}
+    stale_entry = {"path": "mod.py", "rule": "DK102",
+                   "text": "this line no longer exists", "reason": "gone"}
+    new, stale = apply_baseline(findings, [entry, stale_entry], files)
+    assert new == []
+    assert stale == [stale_entry]
+
+
+def test_all_rules_registered():
+    assert sorted(all_rules()) == ["DK101", "DK102", "DK103", "DK104", "DK105"]
+
+
+def test_baseline_entries_have_reasons():
+    entries = load_baseline(BASELINE)
+    assert entries, "committed baseline should not be empty-yet-present"
+    for e in entries:
+        assert e.get("reason", "").strip(), f"baseline entry lacks a reason: {e}"
+
+
+# ---------------------------------------------------------------- the gate
+
+def test_package_is_clean_modulo_baseline():
+    """The enforced invariant: dklint over distkeras_tpu/ yields zero
+    findings that the committed baseline does not account for."""
+    pkg = os.path.join(REPO_ROOT, "distkeras_tpu")
+    findings, files = analyze([pkg], root=REPO_ROOT)
+    new, _stale = apply_baseline(findings, load_baseline(BASELINE), files)
+    assert new == [], "new dklint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    ok = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", "distkeras_tpu",
+         "--root", REPO_ROOT],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    dirty = subprocess.run(
+        [sys.executable, "-m", "tools.dklint",
+         os.path.join("tests", "lint_fixtures"), "--no-baseline",
+         "--root", REPO_ROOT],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert dirty.returncode == 1
+    assert "DK101" in dirty.stdout
+
+
+def test_cli_json_format():
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dklint",
+         os.path.join("tests", "lint_fixtures", "dk104_mesh_axes.py"),
+         "--no-baseline", "--root", REPO_ROOT, "--format", "json"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    payload = json.loads(out.stdout)
+    assert [f["rule"] for f in payload] == ["DK104"] * 3

@@ -1,6 +1,8 @@
 """Combined data x sequence parallelism: Transformer training on a 2-D mesh."""
 
 import jax
+
+from distkeras_tpu.utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -38,7 +40,7 @@ def test_sp_forward_matches_unsharded():
     from jax.sharding import PartitionSpec as P
 
     sp_adapter = _model("seq")
-    out_sp = _jax.shard_map(
+    out_sp = shard_map(
         lambda xx: sp_adapter.apply(params, {}, xx)[0],
         mesh=sp.mesh, in_specs=(P(None, "seq"),), out_specs=P(),
         check_vma=False,

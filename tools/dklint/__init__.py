@@ -1,0 +1,26 @@
+"""dklint — a JAX/TPU-aware static analyzer for the distkeras_tpu stack.
+
+Run with ``python -m tools.dklint distkeras_tpu/`` (see tools/dklint/cli.py
+for flags).  Rules:
+
+  DK101 host-sync-in-hot-path   — .item()/float()/np.asarray/device_get/
+                                  block_until_ready inside traced code
+  DK102 recompilation-hazard    — jit patterns that retrace per call
+  DK103 donation-misuse         — donated buffers read after the call
+  DK104 mesh-axis-consistency   — collectives over undeclared axis names
+  DK105 off-lock-mutation       — guarded attributes written without the lock
+
+Programmatic surface: :func:`analyze`, :func:`apply_baseline`,
+:func:`load_baseline`, :class:`Finding`, and the registry in
+:mod:`tools.dklint.registry` for adding checkers.
+"""
+
+from tools.dklint.core import (  # noqa: F401
+    Checker,
+    Finding,
+    analyze,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+from tools.dklint.registry import all_rules, register  # noqa: F401

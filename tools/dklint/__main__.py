@@ -1,0 +1,5 @@
+import sys
+
+from tools.dklint.cli import main
+
+sys.exit(main())

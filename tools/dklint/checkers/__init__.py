@@ -1,0 +1,9 @@
+"""Importing this package registers every shipped checker."""
+
+from tools.dklint.checkers import (  # noqa: F401 — registration side effects
+    donation,
+    host_sync,
+    locks,
+    mesh_axes,
+    recompile,
+)

@@ -1,0 +1,159 @@
+"""DK103 — donated buffer read after the donating call.
+
+``jax.jit(fn, donate_argnums=(0,))`` hands argument 0's buffer to XLA: the
+array object on the host still exists, but touching it after the call raises
+``RuntimeError: Array has been deleted`` — or, under some transfers, reads
+garbage.  The analyzer tracks, *within one function body*:
+
+  * local names bound from a ``jax.jit(..., donate_argnums=...)`` call
+    (``epoch_fn = jax.jit(fn, donate_argnums=(0,))``), and
+  * direct immediate invocations (``jax.jit(fn, donate_argnums=(0,))(state)``),
+
+then flags any load of a donated argument name after the donating call and
+before the name is rebound.  A rebind on the call line itself
+(``state, stats = epoch_fn(state, xs)``) is the blessed idiom and is not
+flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+
+JIT_NAMES = ("jax.jit", "jit")
+
+
+def _donated_argnums(call: ast.Call) -> Tuple[int, ...]:
+    """Literal donate_argnums of a jax.jit call, () if absent/unresolvable."""
+    if call_name(call) not in JIT_NAMES:
+        return ()
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        nums = [
+            n.value
+            for n in ast.walk(kw.value)
+            if isinstance(n, ast.Constant) and isinstance(n.value, int)
+        ]
+        return tuple(nums)
+    return ()
+
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    """Names (re)bound by a statement (assign/augassign/for targets...)."""
+    out: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in stmt.items if i.optional_vars]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+    return out
+
+
+class _FnBody:
+    """Statements of one function in source order, nested defs excluded."""
+
+    def __init__(self, fn: ast.AST):
+        self.statements: List[ast.stmt] = []
+        self._walk(fn.body if not isinstance(fn, ast.Lambda) else [])
+
+    def _walk(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self.statements.append(stmt)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scope: separate analysis
+            for field in ("body", "orelse", "finalbody"):
+                self._walk(getattr(stmt, field, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk(handler.body)
+
+
+@register
+class DonationChecker(Checker):
+    rule = "DK103"
+    name = "donation-misuse"
+    description = (
+        "argument buffer donated via donate_argnums is read after the "
+        "donating call"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn in ast.walk(fi.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(fi, fn))
+        return findings
+
+    def _check_fn(self, fi: FileInfo, fn: ast.AST) -> Iterable[Finding]:
+        body = _FnBody(fn)
+        # local name -> donated argnums of the jitted callable it holds
+        jitted: Dict[str, Tuple[int, ...]] = {}
+        for stmt in body.statements:
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                nums = _donated_argnums(stmt.value)
+                if nums:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            jitted[t.id] = nums
+
+        for i, stmt in enumerate(body.statements):
+            for call in ast.walk(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                nums: Tuple[int, ...] = ()
+                if isinstance(call.func, ast.Name) and call.func.id in jitted:
+                    nums = jitted[call.func.id]
+                elif isinstance(call.func, ast.Call):
+                    nums = _donated_argnums(call.func)
+                if not nums:
+                    continue
+                donated = {
+                    call.args[n].id
+                    for n in nums
+                    if n < len(call.args) and isinstance(call.args[n], ast.Name)
+                }
+                # the donating statement may rebind (state, _ = f(state, ...))
+                donated -= _assigned_names(stmt)
+                if donated:
+                    yield from self._uses_after(fi, body, i, call, donated)
+
+    def _uses_after(
+        self,
+        fi: FileInfo,
+        body: _FnBody,
+        call_idx: int,
+        call: ast.Call,
+        donated: Set[str],
+    ) -> Iterable[Finding]:
+        live = set(donated)
+        for stmt in body.statements[call_idx + 1:]:
+            if not live:
+                return
+            for node in ast.walk(stmt):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in live
+                ):
+                    yield Finding(
+                        path=fi.relpath, line=node.lineno, col=node.col_offset,
+                        rule=self.rule,
+                        message=(
+                            f"'{node.id}' was donated to the jitted call on "
+                            f"line {call.lineno} (donate_argnums); its buffer "
+                            "no longer exists — use the call's output instead"
+                        ),
+                    )
+                    live.discard(node.id)
+            live -= _assigned_names(stmt)

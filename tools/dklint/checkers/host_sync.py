@@ -1,0 +1,299 @@
+"""DK101 — host-device synchronisation inside a hot (traced) path.
+
+A ``.item()``, ``float(traced)``, ``np.asarray``, ``jax.device_get`` or
+``block_until_ready`` inside a jitted body either fails at trace time or —
+worse, when it traces — silently forces a device round-trip per step,
+destroying the async-dispatch pipelining the windowed engines depend on.
+
+"Hot" functions are found statically:
+
+  * functions decorated with ``jax.jit`` (bare or via ``functools.partial``);
+  * functions passed by name to ``jax.jit`` / ``jax.vmap`` / ``jax.shard_map``
+    / ``lax.scan`` / ``jax.checkpoint`` / ``jax.grad`` /
+    ``jax.value_and_grad`` / ``jax.remat`` at any call site in the file;
+  * the engine step-loop methods of ``*Engine`` classes (the
+    ``WindowedEngine`` family's window/step bodies, which are traced even
+    though the ``jax.jit`` call happens a method away);
+  * anything those functions call by local name (``self._helper(...)`` or
+    ``_helper(...)``), propagated to a fixpoint within the module;
+  * every ``def``/``lambda`` nested inside a hot function.
+
+``float``/``int`` casts are only flagged when applied to a *parameter of the
+hot function itself* (a traced value); casts of closure variables from the
+enclosing factory are trace-time constants and stay legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name, dotted_name
+from tools.dklint.registry import register
+
+# Methods of *Engine classes whose bodies (and nested defs) execute under
+# trace: the step/window loops and the helpers they are documented to call.
+ENGINE_HOT_METHODS = frozenset({
+    "_local_step",
+    "_window_fn",
+    "_step_fn",
+    "_build_epoch_core",
+    "_make_epoch_fn",
+    "_make_multi_epoch_fn",
+    "_make_stepwise_epoch_fn",
+    "_sync_grads",
+    "_make_ctx",
+    "_sync_model_state",
+    "_reduce_seq_stats",
+    "_fsdp_gather",
+    "_fsdp_shard",
+})
+
+# Call targets that trace their function argument.
+TRACING_WRAPPERS = frozenset({
+    "jax.jit", "jit",
+    "jax.vmap", "vmap",
+    "jax.pmap", "pmap",
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+    "lax.scan", "jax.lax.scan",
+    "lax.cond", "jax.lax.cond",
+    "lax.while_loop", "jax.lax.while_loop",
+    "lax.fori_loop", "jax.lax.fori_loop",
+    "jax.checkpoint", "jax.remat",
+    "jax.grad", "jax.value_and_grad",
+})
+
+HOST_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get transfers to host",
+    "jax.block_until_ready": "jax.block_until_ready blocks dispatch",
+    "np.asarray": "np.asarray materialises a device array on host",
+    "np.array": "np.array materialises a device array on host",
+    "numpy.asarray": "np.asarray materialises a device array on host",
+    "numpy.array": "np.array materialises a device array on host",
+}
+
+HOST_SYNC_METHODS = {
+    "item": ".item() forces a device->host sync",
+    "block_until_ready": ".block_until_ready() blocks dispatch",
+    "tolist": ".tolist() forces a device->host sync",
+}
+
+
+def _decorator_jits(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        name = dotted_name(dec)
+        if name in ("jax.jit", "jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            cname = call_name(dec)
+            if cname in ("jax.jit", "jit"):
+                return True
+            # functools.partial(jax.jit, ...) — rare but cheap to cover
+            if cname in ("functools.partial", "partial") and dec.args:
+                if dotted_name(dec.args[0]) in ("jax.jit", "jit"):
+                    return True
+    return False
+
+
+class _FnIndex(ast.NodeVisitor):
+    """Index every def/lambda in a module: id(node) -> (name, parent id)."""
+
+    def __init__(self) -> None:
+        self.parents: Dict[int, Optional[int]] = {}
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        self.fns: List[ast.AST] = []
+        self.in_engine_class: Set[int] = set()
+        self._stack: List[ast.AST] = []
+        self._class_stack: List[str] = []
+
+    def _enter_fn(self, node: ast.AST, name: str) -> None:
+        self.fns.append(node)
+        self.parents[id(node)] = id(self._stack[-1]) if self._stack else None
+        self.by_name.setdefault(name, []).append(node)
+        if self._class_stack and self._class_stack[-1].endswith("Engine"):
+            self.in_engine_class.add(id(node))
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_fn(node, node.name)
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_fn(node, "<lambda>")
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+
+def _function_args_passed_to_tracers(tree: ast.Module) -> Set[str]:
+    """Names passed as the function argument of a tracing wrapper call."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node) not in TRACING_WRAPPERS:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name):
+                if arg.value.id == "self":
+                    names.add(arg.attr)
+    return names
+
+
+def _local_calls(fn: ast.AST) -> Set[str]:
+    """Names this function calls as ``name(...)`` or ``self.name(...)``,
+    excluding calls that happen inside nested defs (those are their own
+    functions)."""
+    out: Set[str] = set()
+    nested: Set[int] = set()
+    for child in ast.walk(fn):
+        if child is fn:
+            continue
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            nested.add(id(child))
+            for sub in ast.walk(child):
+                nested.add(id(sub))
+    for node in ast.walk(fn):
+        if id(node) in nested or not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name):
+            out.add(node.func.id)
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+        ):
+            out.add(node.func.attr)
+    return out
+
+
+def hot_functions(tree: ast.Module) -> Set[int]:
+    """ids of every AST function node considered hot (see module docstring)."""
+    index = _FnIndex()
+    index.visit(tree)
+    traced_names = _function_args_passed_to_tracers(tree)
+
+    hot: Set[int] = set()
+    for fn in index.fns:
+        name = getattr(fn, "name", "<lambda>")
+        if _decorator_jits(fn):
+            hot.add(id(fn))
+        elif name in traced_names:
+            hot.add(id(fn))
+        elif id(fn) in index.in_engine_class and name in ENGINE_HOT_METHODS:
+            hot.add(id(fn))
+
+    # fixpoint: callees of hot functions (by local/self name) become hot
+    calls = {id(fn): _local_calls(fn) for fn in index.fns}
+    changed = True
+    while changed:
+        changed = False
+        for fn in index.fns:
+            if id(fn) not in hot:
+                continue
+            for callee_name in calls[id(fn)]:
+                for callee in index.by_name.get(callee_name, []):
+                    if id(callee) not in hot:
+                        hot.add(id(callee))
+                        changed = True
+
+    # nesting: defs inside a hot function are hot
+    changed = True
+    while changed:
+        changed = False
+        for fn in index.fns:
+            parent = index.parents.get(id(fn))
+            if parent in hot and id(fn) not in hot:
+                hot.add(id(fn))
+                changed = True
+    return hot
+
+
+def _own_params(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+@register
+class HostSyncChecker(Checker):
+    rule = "DK101"
+    name = "host-sync-in-hot-path"
+    description = (
+        "host-device sync (.item()/float()/np.asarray/jax.device_get/"
+        "block_until_ready) inside a jitted or engine-step-loop function"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        hot = hot_functions(fi.tree)
+        findings: List[Finding] = []
+        for fn in ast.walk(fi.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if id(fn) not in hot:
+                continue
+            params = _own_params(fn)
+            findings.extend(self._check_body(fi, fn, params, hot))
+        return findings
+
+    def _check_body(
+        self, fi: FileInfo, fn: ast.AST, params: Set[str], hot: Set[int]
+    ) -> Iterable[Finding]:
+        # skip nested functions: they are visited as their own hot functions
+        # (with their own params), so a body walk must not descend into them
+        nested: Set[int] = set()
+        for child in ast.walk(fn):
+            if child is not fn and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                for sub in ast.walk(child):
+                    nested.add(id(sub))
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname in HOST_SYNC_CALLS:
+                yield self._finding(fi, node, HOST_SYNC_CALLS[cname])
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in HOST_SYNC_METHODS
+                and not node.args
+            ):
+                # jax.block_until_ready(x) handled above; x.item() here
+                yield self._finding(fi, node, HOST_SYNC_METHODS[node.func.attr])
+                continue
+            if cname in ("float", "int") and len(node.args) == 1:
+                arg = node.args[0]
+                # flag only casts of this function's own (traced) parameters;
+                # closure variables from the enclosing factory are trace-time
+                # constants (e.g. float(window) in a window-body closure)
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    yield self._finding(
+                        fi, node,
+                        f"{cname}() on traced argument '{arg.id}' forces a "
+                        "host sync (use jnp casts, or mark it static)",
+                    )
+
+    def _finding(self, fi: FileInfo, node: ast.AST, why: str) -> Finding:
+        return Finding(
+            path=fi.relpath,
+            line=node.lineno,
+            col=node.col_offset,
+            rule=self.rule,
+            message=f"host sync in hot path: {why}",
+        )

@@ -1,0 +1,180 @@
+"""DK105 — shared attribute written outside the lock that guards it.
+
+For every class that owns a lock-like attribute (``threading.Lock`` /
+``RLock`` / ``Condition`` / ``Semaphore`` assigned in ``__init__``), the
+checker partitions every ``self.<attr>`` *write* (plain/aug/subscript
+assignment and known mutating method calls like ``.append``/``.pop``) into
+inside-lock (lexically within a ``with self.<lock>:`` block) and
+outside-lock sites.
+
+An attribute is *guarded* if any of its accesses — read or write — happen
+inside a lock block.  Every outside-lock **write** to a guarded attribute is
+flagged: the coordination threads (job queue runner, PS accept loop) wake
+under the condition variable and read the predicate there, so a write that
+bypasses the lock can be reordered past the ``notify`` or miss a waiter
+entirely.  ``__init__``/``__new__`` writes are exempt (no concurrent reader
+can exist yet).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+
+LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+}
+
+MUTATING_METHODS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "clear", "update", "setdefault", "add", "discard", "sort", "reverse",
+}
+
+CONSTRUCTORS = {"__init__", "__new__", "__post_init__"}
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'attr' when node is ``self.attr``, else ''."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Walk one method, tracking the ``with self.<lock>:`` nesting depth."""
+
+    def __init__(self, lock_attrs: Set[str], method: str):
+        self.lock_attrs = lock_attrs
+        self.method = method
+        self.depth = 0
+        # attr -> list of (node, inside_lock) write sites
+        self.writes: List[Tuple[str, ast.AST, bool]] = []
+        # attrs read or written inside a lock block
+        self.locked_accesses: Set[str] = set()
+
+    def _note_write(self, attr: str, node: ast.AST) -> None:
+        if not attr or attr in self.lock_attrs:
+            return
+        self.writes.append((attr, node, self.depth > 0))
+        if self.depth > 0:
+            self.locked_accesses.add(attr)
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(
+            _self_attr(item.context_expr) in self.lock_attrs
+            for item in node.items
+        )
+        if locked:
+            self.depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.depth -= 1
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr and self.depth > 0:
+            self.locked_accesses.add(attr)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._note_target(target, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._note_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._note_target(node.target, node)
+        self.generic_visit(node)
+
+    def _note_target(self, target: ast.AST, stmt: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._note_target(el, stmt)
+            return
+        attr = _self_attr(target)
+        if attr:
+            self._note_write(attr, stmt)
+            return
+        # self.attr[key] = ... / self.attr[key] += ...
+        if isinstance(target, ast.Subscript):
+            self._note_write(_self_attr(target.value), stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # self.attr.append(...) and friends
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in MUTATING_METHODS
+        ):
+            self._note_write(_self_attr(node.func.value), node)
+        self.generic_visit(node)
+
+
+@register
+class OffLockMutationChecker(Checker):
+    rule = "DK105"
+    name = "off-lock-mutation"
+    description = (
+        "attribute guarded by a lock/condition elsewhere is written "
+        "outside any 'with <lock>:' block"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in ast.walk(fi.tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(self._check_class(fi, cls))
+        return findings
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        locks: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+                continue
+            if call_name(node.value) in LOCK_FACTORIES:
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr:
+                        locks.add(attr)
+        return locks
+
+    def _check_class(self, fi: FileInfo, cls: ast.ClassDef) -> Iterable[Finding]:
+        locks = self._lock_attrs(cls)
+        if not locks:
+            return
+        scans: List[_MethodScan] = []
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _MethodScan(locks, node.name)
+                scan.visit(node)
+                scans.append(scan)
+        guarded: Set[str] = set()
+        for scan in scans:
+            guarded |= scan.locked_accesses
+        for scan in scans:
+            if scan.method in CONSTRUCTORS:
+                continue
+            for attr, node, inside in scan.writes:
+                if inside or attr not in guarded:
+                    continue
+                yield Finding(
+                    path=fi.relpath, line=node.lineno, col=node.col_offset,
+                    rule=self.rule,
+                    message=(
+                        f"'self.{attr}' is accessed under "
+                        f"{'/'.join(sorted('self.' + l for l in locks))} "
+                        f"elsewhere but written here (in {scan.method}) "
+                        "without holding the lock"
+                    ),
+                )

@@ -1,0 +1,141 @@
+"""DK104 — collective axis names cross-checked against declared mesh axes.
+
+A ``lax.psum(x, "worker")`` against a mesh whose axis is named ``"workers"``
+fails at trace time *if you're lucky* — under ``shard_map(check_vma=False)``
+or nested vmap axis names it can silently reduce over the wrong axis and
+produce stale-axis gradients.  The checker:
+
+  pass 1 — collects every axis name *declared* anywhere in the analyzed
+  tree: module-level ``*_AXIS = "name"`` string constants, literal elements
+  of ``axis_names=(...)`` tuples (``Mesh``/``make_mesh_grid``/``shard_map``),
+  ``axis_name="..."`` keyword literals (``make_mesh``/``vmap``/``pmap``),
+  and positional axis-name tuples of ``Mesh(devices, ("a", "b"))``;
+
+  pass 2 — checks the axis argument of every collective
+  (``psum``/``pmean``/``pmax``/``pmin``/``all_gather``/``psum_scatter``/
+  ``ppermute``/``all_to_all``/``axis_index``): a string literal (or a name
+  resolvable to a module-level string constant) that is not in the declared
+  set is flagged.  Unresolvable expressions (``self.axis``) are trusted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+
+COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+    "ppermute", "all_to_all", "axis_index", "axis_size",
+}
+# collective_name -> index of the positional axis-name argument
+AXIS_ARG_INDEX = {name: 1 for name in COLLECTIVES}
+AXIS_ARG_INDEX["axis_index"] = 0
+AXIS_ARG_INDEX["axis_size"] = 0
+
+MESH_CONSTRUCTORS = {"Mesh", "jax.sharding.Mesh", "make_mesh_grid", "make_mesh"}
+AXIS_NAME_KWARG_FNS = {
+    "make_mesh", "jax.vmap", "vmap", "jax.pmap", "pmap", "lax.scan",
+}
+
+
+def _literal_strs(node: ast.AST) -> List[str]:
+    return [
+        n.value
+        for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    ]
+
+
+@register
+class MeshAxisChecker(Checker):
+    rule = "DK104"
+    name = "mesh-axis-consistency"
+    description = (
+        "collective called with an axis name not declared by any mesh "
+        "construction or *_AXIS constant in the analyzed tree"
+    )
+
+    KEY = "DK104.declared"
+
+    # ---------------------------------------------------------------- pass 1
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        declared: Set[str] = project.data.setdefault(self.KEY, set())
+        # module-level *_AXIS string constants (any name, really — a string
+        # constant fed to an axis_name slot elsewhere resolves through
+        # fi.str_constants in pass 2, but AXIS-suffixed ones are declarations
+        # in their own right)
+        for name, value in fi.str_constants.items():
+            if name.endswith("AXIS"):
+                declared.add(value)
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname is None:
+                continue
+            short = cname.rsplit(".", 1)[-1]
+            if short in {c.rsplit(".", 1)[-1] for c in MESH_CONSTRUCTORS}:
+                # Mesh(devices, ("workers", "seq")) — second positional arg
+                if len(node.args) >= 2:
+                    declared.update(_literal_strs(node.args[1]))
+            for kw in node.keywords:
+                if kw.arg in ("axis_names", "axis_name"):
+                    declared.update(_literal_strs(kw.value))
+                    # names via constants: axis_names=(WORKER_AXIS, PP_AXIS)
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Name) and n.id in fi.str_constants:
+                            declared.add(fi.str_constants[n.id])
+
+    # ---------------------------------------------------------------- pass 2
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        declared: Set[str] = project.data.get(self.KEY, set())
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname is None:
+                continue
+            short = cname.rsplit(".", 1)[-1]
+            if short not in COLLECTIVES:
+                continue
+            axis_expr = self._axis_argument(node, short)
+            if axis_expr is None:
+                continue
+            for axis in self._resolve_axes(fi, axis_expr):
+                if axis not in declared:
+                    yield Finding(
+                        path=fi.relpath,
+                        line=axis_expr.lineno,
+                        col=axis_expr.col_offset,
+                        rule=self.rule,
+                        message=(
+                            f"{short} over axis '{axis}', which no mesh "
+                            "construction or *_AXIS constant declares "
+                            f"(declared: {', '.join(sorted(declared)) or 'none'})"
+                        ),
+                    )
+
+    def _axis_argument(self, node: ast.Call, short: str) -> Optional[ast.AST]:
+        # NB: collectives' axis-name kwarg is ``axis_name``; ``axis=`` on
+        # all_gather/psum_scatter is the array *dimension*, not an axis name
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                return kw.value
+        idx = AXIS_ARG_INDEX[short]
+        if idx < len(node.args):
+            return node.args[idx]
+        return None
+
+    def _resolve_axes(self, fi: FileInfo, expr: ast.AST) -> Iterable[str]:
+        """String values the axis expression definitely denotes; empty when
+        unresolvable (trusted)."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            yield expr.value
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:
+                yield from self._resolve_axes(fi, el)
+        elif isinstance(expr, ast.Name) and expr.id in fi.str_constants:
+            yield fi.str_constants[expr.id]

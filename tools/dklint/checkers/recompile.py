@@ -1,0 +1,164 @@
+"""DK102 — silent-recompilation hazards.
+
+Three patterns, all of which defeat ``jax.jit``'s trace cache and recompile
+the program on every call (or every loop iteration):
+
+  * **immediate invocation** — ``jax.jit(fn, ...)(args)``: the wrapper is
+    built fresh each time the enclosing statement runs, so the trace cache
+    (keyed on the function object) never hits.  Hoist the ``jax.jit`` call
+    out and reuse the wrapper (cache it on ``self`` for per-engine
+    shardings);
+  * **jit in a loop** — ``jax.jit(...)`` anywhere inside a ``for``/``while``
+    body: a new wrapper (and a recompile) per iteration;
+  * **Python control flow on a traced argument** — a ``jax.jit``-decorated
+    function using a parameter in ``if``/``while``/``range()`` without
+    naming it in ``static_argnums``/``static_argnames``: every distinct
+    value recompiles (and non-scalar values fail outright).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Set
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name, dotted_name
+from tools.dklint.registry import register
+
+JIT_NAMES = ("jax.jit", "jit")
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and call_name(node) in JIT_NAMES
+
+
+def _static_params(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """Parameter names marked static in a ``jax.jit`` decorator, or None if
+    the decoration carries no static markers we can resolve."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call) or call_name(dec) not in JIT_NAMES:
+            continue
+        static: Set[str] = set()
+        pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        static.add(el.value)
+            elif kw.arg == "static_argnums":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        if 0 <= el.value < len(pos):
+                            static.add(pos[el.value])
+        return static
+    return None
+
+
+def _jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if dotted_name(dec) in JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call) and call_name(dec) in JIT_NAMES:
+            return True
+    return False
+
+
+@register
+class RecompileChecker(Checker):
+    rule = "DK102"
+    name = "recompilation-hazard"
+    description = (
+        "jax.jit patterns that retrace per call: immediate invocation, "
+        "jit inside a loop, Python control flow on a non-static argument"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._immediate_invocations(fi))
+        findings.extend(self._jit_in_loops(fi))
+        findings.extend(self._nonstatic_control_flow(fi))
+        return findings
+
+    # -- jax.jit(fn, ...)(args) --------------------------------------------
+    def _immediate_invocations(self, fi: FileInfo) -> Iterable[Finding]:
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Call) and _is_jit_call(node.func):
+                yield Finding(
+                    path=fi.relpath, line=node.lineno, col=node.col_offset,
+                    rule=self.rule,
+                    message=(
+                        "jax.jit(...)(...) builds a fresh wrapper per call "
+                        "and retraces every time; hoist the jit and reuse it"
+                    ),
+                )
+
+    # -- jax.jit inside for/while bodies ------------------------------------
+    def _jit_in_loops(self, fi: FileInfo) -> Iterable[Finding]:
+        # immediate invocations are already reported by the pattern above
+        immediate = {
+            id(n.func)
+            for n in ast.walk(fi.tree)
+            if isinstance(n, ast.Call) and _is_jit_call(n.func)
+        }
+        reported: Set[int] = set()
+        for loop in ast.walk(fi.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if _is_jit_call(node) and id(node) not in immediate and id(node) not in reported:
+                    reported.add(id(node))
+                    yield Finding(
+                        path=fi.relpath, line=node.lineno, col=node.col_offset,
+                        rule=self.rule,
+                        message=(
+                            "jax.jit inside a loop body creates a new "
+                            "wrapper (and a recompile) per iteration"
+                        ),
+                    )
+
+    # -- traced args used in Python control flow ----------------------------
+    def _nonstatic_control_flow(self, fi: FileInfo) -> Iterable[Finding]:
+        for fn in ast.walk(fi.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _jit_decorated(fn):
+                continue
+            static = _static_params(fn) or set()
+            params = {
+                a.arg
+                for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+                if a.arg not in ("self", "cls")
+            } - static
+            nested: Set[int] = set()
+            for child in ast.walk(fn):
+                if child is not fn and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    nested.update(id(s) for s in ast.walk(child))
+
+            def hazards(expr: ast.AST) -> Sequence[str]:
+                return sorted({
+                    n.id for n in ast.walk(expr)
+                    if isinstance(n, ast.Name) and n.id in params
+                })
+
+            for node in ast.walk(fn):
+                if id(node) in nested:
+                    continue
+                if isinstance(node, (ast.If, ast.While)):
+                    for name in hazards(node.test):
+                        yield self._cf_finding(fi, node, name, "branch condition")
+                elif isinstance(node, ast.Call) and call_name(node) == "range":
+                    for arg in node.args:
+                        for name in hazards(arg):
+                            yield self._cf_finding(fi, node, name, "range() bound")
+
+    def _cf_finding(self, fi: FileInfo, node: ast.AST, name: str, where: str) -> Finding:
+        return Finding(
+            path=fi.relpath, line=node.lineno, col=node.col_offset,
+            rule=self.rule,
+            message=(
+                f"traced argument '{name}' used as Python {where} in a jitted "
+                "function: every distinct value recompiles (mark it in "
+                "static_argnums/static_argnames or use lax control flow)"
+            ),
+        )

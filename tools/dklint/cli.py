@@ -1,0 +1,97 @@
+"""dklint command line: ``python -m tools.dklint [paths...]``.
+
+Exit codes: 0 — clean (or every finding baselined); 1 — unbaselined
+findings (or analyzed-file syntax errors); 2 — usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from tools.dklint import core
+from tools.dklint.registry import all_rules
+
+DEFAULT_BASELINE = os.path.join("tools", "dklint", "baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.dklint",
+        description="JAX/TPU-aware static analyzer for the distkeras_tpu training stack",
+    )
+    p.add_argument("paths", nargs="*", default=["distkeras_tpu"],
+                   help="files or directories to analyze (default: distkeras_tpu)")
+    p.add_argument("--root", default=None,
+                   help="project root findings/baseline paths are relative to "
+                        "(default: cwd)")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {DEFAULT_BASELINE} under "
+                        "--root when it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list registered rules and exit")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule, cls in all_rules().items():
+            print(f"{rule}  {cls.name}: {cls.description}")
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    select = [s for s in (args.select or "").split(",") if s] or None
+    try:
+        findings, files = core.analyze(args.paths, root=root, select=select)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"dklint: {e}", file=sys.stderr)
+        return 2
+    except SyntaxError as e:
+        print(f"dklint: cannot parse {e.filename}:{e.lineno}: {e.msg}", file=sys.stderr)
+        return 1
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.write_baseline:
+        core.save_baseline(baseline_path, findings, files)
+        print(f"dklint: wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    stale: List[dict] = []
+    if not args.no_baseline and os.path.exists(baseline_path):
+        entries = core.load_baseline(baseline_path)
+        findings, stale = core.apply_baseline(findings, entries, files)
+
+    if args.format == "json":
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        for e in stale:
+            print(
+                f"dklint: stale baseline entry ({e.get('path')}: {e.get('rule')} "
+                f"{e.get('text', '')!r}) — violation fixed? prune it",
+                file=sys.stderr,
+            )
+        if findings:
+            print(
+                f"dklint: {len(findings)} unbaselined finding(s)",
+                file=sys.stderr,
+            )
+
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,285 @@
+"""dklint core: findings, file model, suppressions, baseline, the run loop.
+
+The analyzer is a two-pass AST walk over a set of Python files:
+
+  pass 1 (``Checker.collect``) lets every checker gather *project-wide*
+  facts — e.g. DK104 collects the mesh-axis names declared anywhere in the
+  analyzed tree before any call site is judged;
+
+  pass 2 (``Checker.check``) emits :class:`Finding`s per file.
+
+Findings are filtered through two suppression layers:
+
+  * ``# dklint: disable=DK101[,DK102...]`` as a *trailing* comment on a code
+    line suppresses those rules for that line;
+  * the same directive on a *standalone* comment line suppresses the rules
+    for the whole file (the per-file form ISSUE.md specifies);
+  * a committed baseline file grandfathers findings by
+    ``(path, rule, stripped source text)`` — line numbers are deliberately
+    not part of the fingerprint so unrelated edits don't invalidate it.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+DISABLE_PREFIX = "dklint: disable="
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str  # root-relative, forward slashes
+    line: int  # 1-based
+    col: int  # 0-based
+    rule: str  # e.g. "DK101"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class FileInfo:
+    """Parsed view of one analyzed file."""
+
+    abspath: str
+    relpath: str
+    source: str
+    tree: ast.Module
+    lines: List[str]
+    # rule -> set of suppressed line numbers; "*" key would be redundant —
+    # file-wide suppressions live in file_disabled instead
+    line_disabled: Dict[int, Set[str]] = field(default_factory=dict)
+    file_disabled: Set[str] = field(default_factory=set)
+    # module-level ``NAME = "literal"`` string constants (DK104 resolution)
+    str_constants: Dict[str, str] = field(default_factory=dict)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Project:
+    """Shared state across all analyzed files (filled during pass 1)."""
+
+    def __init__(self, root: str, files: Sequence[FileInfo]):
+        self.root = root
+        self.files = list(files)
+        # free-form scratch space keyed by checker rule id
+        self.data: Dict[str, object] = {}
+
+
+class Checker:
+    """Base class; subclasses register via :func:`tools.dklint.registry.register`."""
+
+    rule: str = ""  # "DK1xx"
+    name: str = ""  # short slug, e.g. "host-sync-in-hot-path"
+    description: str = ""
+
+    def collect(self, project: Project, fi: FileInfo) -> None:  # pass 1
+        return None
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:  # pass 2
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------- suppressions
+
+def _parse_directive(comment: str) -> Optional[Set[str]]:
+    """``# dklint: disable=DK101,DK105`` -> {"DK101", "DK105"}; None if the
+    comment is not a dklint directive.  ``disable=all`` disables everything."""
+    text = comment.lstrip("#").strip()
+    if not text.startswith(DISABLE_PREFIX):
+        return None
+    rules = text[len(DISABLE_PREFIX):].split()[0]  # ignore trailing prose
+    return {r.strip().upper() for r in rules.split(",") if r.strip()}
+
+
+def scan_suppressions(fi: FileInfo) -> None:
+    """Populate ``fi.line_disabled`` / ``fi.file_disabled`` from comments."""
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(fi.source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            rules = _parse_directive(tok.string)
+            if rules is None:
+                continue
+            line_src = fi.lines[tok.start[0] - 1] if tok.start[0] <= len(fi.lines) else ""
+            standalone = line_src.strip().startswith("#")
+            if standalone:
+                fi.file_disabled |= rules
+            else:
+                fi.line_disabled.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass
+
+
+def is_suppressed(fi: FileInfo, finding: Finding) -> bool:
+    if "ALL" in fi.file_disabled or finding.rule in fi.file_disabled:
+        return True
+    rules = fi.line_disabled.get(finding.line, ())
+    return "ALL" in rules or finding.rule in rules
+
+
+# -------------------------------------------------------------------- baseline
+
+def fingerprint(fi: FileInfo, finding: Finding) -> Tuple[str, str, str]:
+    return (finding.path, finding.rule, fi.line_text(finding.line))
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "findings" not in doc:
+        raise ValueError(f"baseline {path}: expected {{'findings': [...]}}")
+    return list(doc["findings"])
+
+
+def save_baseline(path: str, findings: Sequence[Finding], files: Dict[str, FileInfo]) -> None:
+    entries = [
+        {
+            "path": f.path,
+            "rule": f.rule,
+            "text": files[f.path].line_text(f.line),
+            "reason": "",
+        }
+        for f in findings
+    ]
+    doc = {"version": 1, "findings": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding],
+    baseline_entries: Sequence[dict],
+    files: Dict[str, FileInfo],
+) -> Tuple[List[Finding], List[dict]]:
+    """Cancel findings against baseline entries one-for-one.
+
+    Returns ``(new_findings, stale_entries)`` — stale entries matched
+    nothing (the grandfathered violation was fixed or moved)."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline_entries:
+        key = (e.get("path", ""), e.get("rule", ""), e.get("text", "").strip())
+        budget[key] = budget.get(key, 0) + 1
+    new: List[Finding] = []
+    for f in findings:
+        fi = files.get(f.path)
+        key = fingerprint(fi, f) if fi is not None else (f.path, f.rule, "")
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+        else:
+            new.append(f)
+    stale = []
+    for e in baseline_entries:
+        key = (e.get("path", ""), e.get("rule", ""), e.get("text", "").strip())
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            stale.append(e)
+    return new, stale
+
+
+# ------------------------------------------------------------------- the run
+
+def discover(paths: Sequence[str]) -> List[str]:
+    """Expand files/dirs into a sorted list of ``.py`` file paths."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                out.extend(
+                    os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"not a .py file or directory: {p}")
+    return sorted(set(out))
+
+
+def _collect_str_constants(tree: ast.Module) -> Dict[str, str]:
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            consts[node.targets[0].id] = node.value.value
+    return consts
+
+
+def load_file(abspath: str, root: str) -> FileInfo:
+    with open(abspath, "r", encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=abspath)
+    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+    fi = FileInfo(
+        abspath=abspath,
+        relpath=rel,
+        source=source,
+        tree=tree,
+        lines=source.splitlines(),
+    )
+    fi.str_constants = _collect_str_constants(tree)
+    scan_suppressions(fi)
+    return fi
+
+
+def analyze(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    select: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], Dict[str, FileInfo]]:
+    """Run all (or ``select``-ed) checkers over ``paths``.
+
+    Returns suppression-filtered findings (baseline not yet applied) plus
+    the relpath -> FileInfo map the caller needs for fingerprinting."""
+    from tools.dklint.registry import get_checkers
+
+    root = os.path.abspath(root or os.getcwd())
+    files = [load_file(os.path.abspath(p), root) for p in discover(paths)]
+    project = Project(root, files)
+    checkers = get_checkers(select)
+    for checker in checkers:
+        for fi in files:
+            checker.collect(project, fi)
+    findings: List[Finding] = []
+    for checker in checkers:
+        for fi in files:
+            for f in checker.check(project, fi):
+                if not is_suppressed(fi, f):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, {fi.relpath: fi for fi in files}
+
+
+# ------------------------------------------------------------------ AST utils
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.jit`` -> "jax.jit"; Name -> its id; anything else -> None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)

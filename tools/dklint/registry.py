@@ -1,0 +1,36 @@
+"""Checker registry: rule id -> checker class, populated by import side
+effect of :mod:`tools.dklint.checkers`."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+from tools.dklint.core import Checker
+
+_REGISTRY: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    if not cls.rule or not cls.rule.startswith("DK"):
+        raise ValueError(f"checker {cls.__name__} must define a DKxxx rule id")
+    if cls.rule in _REGISTRY:
+        raise ValueError(f"duplicate checker rule {cls.rule}")
+    _REGISTRY[cls.rule] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Checker]]:
+    import tools.dklint.checkers  # noqa: F401 — registration side effect
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+def get_checkers(select: Optional[Sequence[str]] = None) -> List[Checker]:
+    rules = all_rules()
+    if select:
+        wanted = {s.upper() for s in select}
+        unknown = wanted - set(rules)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        rules = {k: v for k, v in rules.items() if k in wanted}
+    return [cls() for cls in rules.values()]
