@@ -21,6 +21,8 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from distkeras_tpu import telemetry
+
 __all__ = [
     "save_checkpoint", "restore_checkpoint", "restore_center",
     "model_state_worker_mean", "latest_step",
@@ -59,7 +61,8 @@ def _pytree_checkpointer():
 def wait_until_finished() -> None:
     """Block until every in-flight async save has committed."""
     if _CHECKPOINTER is not None:
-        _CHECKPOINTER.wait_until_finished()
+        with telemetry.trace.span("checkpoint_flush", phase="ckpt"):
+            _CHECKPOINTER.wait_until_finished()
 
 
 def save_checkpoint(directory: str, state: Any, step: int) -> str:
@@ -68,8 +71,15 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
     import orbax.checkpoint as ocp
 
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    host_state = jax.tree.map(np.asarray, state)
-    _checkpointer().save(path, args=ocp.args.StandardSave(host_state))
+    # "checkpoint_enqueue" covers only the synchronous part of an async
+    # save: the host snapshot plus handing the write to Orbax's thread.
+    with telemetry.trace.span("checkpoint_enqueue", phase="ckpt", step=int(step)):
+        host_state = jax.tree.map(np.asarray, state)
+        _checkpointer().save(path, args=ocp.args.StandardSave(host_state))
+    if telemetry.enabled():
+        telemetry.metrics.counter(
+            "checkpoints_saved_total", help="async checkpoint saves enqueued"
+        ).inc()
     return path
 
 

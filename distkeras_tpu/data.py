@@ -53,16 +53,17 @@ def epoch_arrays(
     idx = np.tile(idx, reps)[:total]
     # Gather is the host-side hot path: multithreaded native kernel when the
     # C++ library is available, bit-identical numpy fallback otherwise.
-    from distkeras_tpu import native
+    from distkeras_tpu import native, telemetry
 
-    xs = native.gather_rows(features, idx)
-    ys = native.gather_rows(labels, idx)
-    if stepwise:
-        shape = (num_workers, n_windows * window, batch_size)
-    else:
-        shape = (num_workers, n_windows, window, batch_size)
-    xs = xs.reshape(shape + features.shape[1:])
-    ys = ys.reshape(shape + labels.shape[1:])
+    with telemetry.trace.span("epoch_arrays", phase="data", rows=int(total)):
+        xs = native.gather_rows(features, idx)
+        ys = native.gather_rows(labels, idx)
+        if stepwise:
+            shape = (num_workers, n_windows * window, batch_size)
+        else:
+            shape = (num_workers, n_windows, window, batch_size)
+        xs = xs.reshape(shape + features.shape[1:])
+        ys = ys.reshape(shape + labels.shape[1:])
     return xs, ys
 
 
@@ -119,7 +120,7 @@ def epoch_window_iter(
     # epoch_arrays reshapes worker-major: worker k / window w covers the flat
     # slice idx2[k, w*window:(w+1)*window] below.
     idx2 = idx.reshape(num_workers, steps, batch_size)
-    from distkeras_tpu import native
+    from distkeras_tpu import native, telemetry
 
     fused_bf16 = (
         feature_dtype is not None
@@ -132,6 +133,8 @@ def epoch_window_iter(
         cur = block.shape[1]  # < window only for a ragged final block
         sel = np.ascontiguousarray(block).ravel()
         block_shape = (num_workers, cur, batch_size)
-        xs = gather_x(features, sel).reshape(block_shape + features.shape[1:])
-        ys = native.gather_rows(labels, sel).reshape(block_shape + labels.shape[1:])
+        with telemetry.trace.span("window_gather", phase="data",
+                                  window=w, rows=int(sel.size)):
+            xs = gather_x(features, sel).reshape(block_shape + features.shape[1:])
+            ys = native.gather_rows(labels, sel).reshape(block_shape + labels.shape[1:])
         yield xs, ys

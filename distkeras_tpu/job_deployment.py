@@ -112,6 +112,16 @@ class PunchcardServer:
             elif action == "list":
                 send_data(conn, {"status": "ok",
                                  "jobs": {k: v["status"] for k, v in self.jobs.items()}})
+            elif action == "metrics":
+                # Control-plane scrape of this process's telemetry registry:
+                # Prometheus text (for scrapers / humans) plus the structured
+                # snapshot, both JSON-safe for the restricted codec.
+                from distkeras_tpu import telemetry
+
+                send_data(conn, {"status": "ok",
+                                 "enabled": telemetry.enabled(),
+                                 "prometheus": telemetry.metrics.to_prometheus(),
+                                 "snapshot": telemetry.metrics.snapshot()})
             else:
                 send_data(conn, {"status": "bad_request"})
         except (ConnectionError, ValueError, OSError):
@@ -177,11 +187,19 @@ class Job:
             raise RuntimeError("job not submitted")
         return self._rpc({"action": "status", "job_id": self.job_id})
 
+    def metrics(self) -> dict:
+        """Scrape the daemon's telemetry registry (``metrics`` verb):
+        ``{"status": "ok", "enabled": ..., "prometheus": <text>,
+        "snapshot": {...}}``."""
+        return self._rpc({"action": "metrics"})
+
     def wait(self, timeout: float = 300.0, poll: float = 0.2) -> dict:
         import time
 
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        # monotonic, not wall-clock: an NTP step mid-poll must not shrink or
+        # stretch the deadline (dklint DK106)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             st = self.status()
             if st["status"] in ("finished", "failed", "timeout"):
                 return st

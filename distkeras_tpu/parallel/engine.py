@@ -37,6 +37,7 @@ from flax import struct
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.algorithms.base import CommitCtx, UpdateRule
 from distkeras_tpu.models.adapter import ModelAdapter
 from distkeras_tpu.ops import get_loss, get_metric, get_optimizer
@@ -819,10 +820,37 @@ class WindowedEngine:
         return jax.jit(epoch_fn, donate_argnums=(0,))
 
     # ----------------------------------------------------------------- public
-    def run_epoch(self, state: TrainState, xs: jnp.ndarray, ys: jnp.ndarray):
+    def _dispatch_with_spans(self, fn, state, xs, ys, n_windows):
+        """Telemetry-enabled dispatch: wrap the (normally fully async) epoch
+        program in window/step/commit spans.
+
+        Phase attribution needs host-visible completion points, so this path
+        blocks on the dispatch outputs — trading async-dispatch overlap for
+        observability.  The trajectory is unchanged (same program, same
+        inputs; asserted in tests/test_telemetry.py).  "step" covers dispatch
+        through loss readiness; "commit" is the residual wait for the
+        committed center params after the losses are already on host — with
+        one fused XLA program that residual is usually small, which is itself
+        the measurement.  Only ever called with telemetry enabled; the
+        disabled path dispatches directly with zero added syncs."""
+        with telemetry.trace.span("window", windows=n_windows):
+            with telemetry.trace.span("step", phase="step"):
+                new_state, stats = fn(state, xs, ys)
+                jax.block_until_ready(stats["loss"])
+            with telemetry.trace.span("commit", phase="commit"):
+                jax.block_until_ready(new_state.center_params)
+        return new_state, stats
+
+    def run_epoch(self, state: TrainState, xs: jnp.ndarray, ys: jnp.ndarray,
+                  *, sync_telemetry: bool = True):
         """Run one epoch.  ``xs``/``ys`` leading dims: [num_workers, n_windows,
         window, batch] (uniform mode) or [num_workers, n_steps, batch]
-        (staleness mode)."""
+        (staleness mode).
+
+        ``sync_telemetry=False`` keeps the dispatch fully asynchronous even
+        when telemetry is enabled (no spans recorded here); the streaming
+        path uses it so double buffering survives and records its own spans
+        at its real sync points instead."""
         if self.commit_schedule is not None:
             key = ("step", xs.shape[1], xs.ndim)
             if key not in self._epoch_fns:
@@ -833,8 +861,11 @@ class WindowedEngine:
             key = ("win", n_windows, window, do_commit, xs.ndim)
             if key not in self._epoch_fns:
                 self._epoch_fns[key] = self._make_epoch_fn(n_windows, window, do_commit, xs.ndim)
+        fn = self._epoch_fns[key]
         with self.mesh:
-            return self._epoch_fns[key](state, xs, ys)
+            if sync_telemetry and telemetry.enabled():
+                return self._dispatch_with_spans(fn, state, xs, ys, int(xs.shape[1]))
+            return fn(state, xs, ys)
 
     def run_epochs(
         self,
@@ -871,8 +902,11 @@ class WindowedEngine:
             self._epoch_fns[key] = self._make_multi_epoch_fn(
                 n_windows, window, do_commit, xs.ndim, num_epochs, shuffle_seed
             )
+        fn = self._epoch_fns[key]
         with self.mesh:
-            return self._epoch_fns[key](state, xs, ys)
+            if telemetry.enabled():
+                return self._dispatch_with_spans(fn, state, xs, ys, n_windows)
+            return fn(state, xs, ys)
 
     def clear_program_cache(self, keep_multi: Optional[tuple] = None) -> None:
         """Drop cached compiled epoch programs.
@@ -941,7 +975,12 @@ class WindowedEngine:
                     break
                 buf.append(put(block))
             xs, ys = buf.popleft()
-            state, stats = self.run_epoch(state, xs, ys)  # async dispatch
+            # async dispatch; sync_telemetry=False because blocking here
+            # would serialise the pipeline — spans are recorded at the real
+            # sync point (the backpressure wait) instead
+            with telemetry.trace.span("window_dispatch", window=n_windows):
+                state, stats = self.run_epoch(
+                    state, xs, ys, sync_telemetry=False)
             n_windows += 1
             losses.append(stats["loss"])
             mets.append(stats["metrics"])
@@ -951,7 +990,9 @@ class WindowedEngine:
             # `prefetch` calls ago caps in-flight windows at prefetch (plus
             # up to prefetch buffered undispatched blocks — see docstring).
             if n_windows > depth:
-                jax.block_until_ready(losses[n_windows - 1 - depth])
+                with telemetry.trace.span("window_wait", phase="step",
+                                          window=n_windows - 1 - depth):
+                    jax.block_until_ready(losses[n_windows - 1 - depth])
             # Refill AFTER dispatching (first window included): the very
             # first window's compute then hides the rest of the initial
             # prefill's source latency — measured, not assumed, in
@@ -1027,12 +1068,24 @@ class WindowedEngine:
         from jax.sharding import NamedSharding
 
         xs_spec, ys_spec = self._data_specs(xs.ndim)
-        with self.mesh:
-            return (
-                jax.make_array_from_callback(
-                    xs.shape, NamedSharding(self.mesh, xs_spec), lambda idx: xs[idx]
-                ),
-                jax.make_array_from_callback(
-                    ys.shape, NamedSharding(self.mesh, ys_spec), lambda idx: ys[idx]
-                ),
-            )
+
+        def _put():
+            with self.mesh:
+                return (
+                    jax.make_array_from_callback(
+                        xs.shape, NamedSharding(self.mesh, xs_spec), lambda idx: xs[idx]
+                    ),
+                    jax.make_array_from_callback(
+                        ys.shape, NamedSharding(self.mesh, ys_spec), lambda idx: ys[idx]
+                    ),
+                )
+
+        if not telemetry.enabled():
+            return _put()
+        # blocking makes the span honest (the transfer itself, not just the
+        # enqueue); only taken when telemetry is on
+        with telemetry.trace.span("h2d", phase="h2d",
+                                  bytes=int(xs.nbytes) + int(ys.nbytes)):
+            out = _put()
+            jax.block_until_ready(out)
+        return out

@@ -18,6 +18,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.frame import DataFrame
 from distkeras_tpu.models.adapter import ModelAdapter, TrainedModel, as_adapter
 from distkeras_tpu.parallel.mesh import make_mesh, replicated_sharding, worker_sharding
@@ -122,20 +123,25 @@ class ModelPredictor(Predictor):
         # distributed path widens the batch so every chip gets batch_size rows.
         bs = self.batch_size * (self.n_dev if distributed else 1)
         outs = []
-        for i in range(0, n, bs):
-            chunk = feats[i : i + bs]
-            pad = bs - len(chunk)
-            if pad:
-                chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
-            if distributed:
-                with self.mesh:
-                    out = self._jit_apply_sharded(
-                        self.params, self.state, self._shard_batch(chunk)
-                    )
-                out = np.asarray(out)
-            else:
-                out = np.asarray(self._jit_apply(self.params, self.state, chunk))
-            outs.append(out[: bs - pad] if pad else out)
+        with telemetry.trace.span("predict", rows=int(n), mode=self.last_mode):
+            for i in range(0, n, bs):
+                chunk = feats[i : i + bs]
+                pad = bs - len(chunk)
+                if pad:
+                    chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
+                # np.asarray already blocks on the device result, so the
+                # per-batch span needs no extra sync
+                with telemetry.trace.span("predict_batch", phase="infer",
+                                          batch=len(chunk)):
+                    if distributed:
+                        with self.mesh:
+                            out = self._jit_apply_sharded(
+                                self.params, self.state, self._shard_batch(chunk)
+                            )
+                        out = np.asarray(out)
+                    else:
+                        out = np.asarray(self._jit_apply(self.params, self.state, chunk))
+                outs.append(out[: bs - pad] if pad else out)
         preds = np.concatenate(outs) if outs else np.zeros((0,))
         if self.adapter.outputs_logits and preds.ndim > 1 and preds.shape[-1] > 1:
             preds = np.asarray(jax.nn.softmax(preds, axis=-1))

@@ -1,0 +1,68 @@
+"""``distkeras_tpu.telemetry`` — spans, metrics, and profiler hooks.
+
+One subsystem, three surfaces:
+
+* :mod:`.trace` — ``trace.span("epoch")`` context managers exporting Chrome
+  trace-event JSON (open in Perfetto);
+* :mod:`.metrics` — process-global registry of counters/gauges/histograms
+  with Prometheus-text, JSONL, and ScalarLogger exporters, plus
+  ``jax.monitoring`` compile hooks;
+* :mod:`.profiler` — step-windowed ``jax.profiler`` capture via
+  ``DISTKERAS_PROFILE=dir``.
+
+Everything is gated on ``DISTKERAS_TELEMETRY`` (see :mod:`.runtime`): with
+the flag unset, ``trace.span()`` returns a shared no-op and instrumented
+code paths take their original branch — no extra host syncs, no extra
+allocations.  Import cost is stdlib-only; jax is touched lazily.
+"""
+
+from __future__ import annotations
+
+import os
+
+from distkeras_tpu.telemetry import runtime
+from distkeras_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    install_jax_hooks,
+    metrics,
+)
+from distkeras_tpu.telemetry.profiler import ProfilerHook
+from distkeras_tpu.telemetry.runtime import configure, enabled, out_dir
+from distkeras_tpu.telemetry.trace import Span, Tracer, trace
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "ProfilerHook",
+    "Registry",
+    "Span",
+    "Tracer",
+    "configure",
+    "enabled",
+    "flush",
+    "install_jax_hooks",
+    "metrics",
+    "out_dir",
+    "runtime",
+    "trace",
+]
+
+
+def flush(directory=None):
+    """Write the trace and a metrics snapshot to ``directory`` (default:
+    :func:`out_dir`).  Returns ``(trace_path, metrics_path)``, or ``None``
+    when telemetry is disabled."""
+    if not enabled():
+        return None
+    d = directory or out_dir()
+    os.makedirs(d, exist_ok=True)
+    pid = os.getpid()
+    trace_path = trace.write(os.path.join(d, f"trace_{pid}.json"))
+    metrics_path = metrics.write_jsonl(
+        os.path.join(d, f"metrics_{pid}.jsonl"), extra={"pid": pid}
+    )
+    return trace_path, metrics_path

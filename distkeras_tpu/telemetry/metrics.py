@@ -1,0 +1,296 @@
+"""Metrics registry: counters, gauges, bounded-bucket histograms.
+
+Instruments are get-or-create through a process-global :data:`metrics`
+registry, so call sites never need to coordinate construction:
+
+    telemetry.metrics.counter("checkpoints_saved_total").inc()
+    telemetry.metrics.histogram("phase_step_seconds").observe(dt)
+
+Exporters: Prometheus text exposition (served by the ``job_deployment``
+daemon's ``metrics`` verb), JSONL snapshots, and a bridge into the existing
+``utils.tb.ScalarLogger``.  ``install_jax_hooks()`` wires ``jax.monitoring``
+listeners so retraces/compiles show up as ``jax_compiles_total`` without any
+polling of jit internals.
+
+Histograms are bounded by construction: a fixed bucket ladder plus one
+overflow slot, so a runaway workload can never grow memory.  All mutation is
+behind a per-instrument lock; reads of a single float/int are atomic in
+CPython and done off-lock.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+
+from distkeras_tpu.telemetry import runtime
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "Gauge",
+    "Histogram",
+    "PHASES",
+    "Registry",
+    "install_jax_hooks",
+    "metrics",
+]
+
+# Exponential seconds ladder: 100µs .. 60s covers everything from a single
+# h2d transfer to a full-epoch dispatch; beyond that lands in +Inf.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+# Canonical phase names for the bench breakdown ("where did the step time
+# go?").  Spans opened with phase=<name> feed phase_<name>_seconds.
+PHASES = ("data", "h2d", "step", "commit")
+
+
+class Counter:
+    """Monotonically increasing float counter."""
+
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins float gauge."""
+
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative ``le`` semantics on export)."""
+
+    __slots__ = ("name", "help", "buckets", "_lock", "_counts", "_sum", "_count")
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one finite bucket")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value):
+        value = float(value)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def sum(self):
+        return self._sum
+
+    @property
+    def count(self):
+        return self._count
+
+    def cumulative(self):
+        """[(upper_bound_label, cumulative_count), ...] ending with +Inf."""
+        with self._lock:
+            counts = list(self._counts)
+        out, running = [], 0
+        for bound, n in zip(self.buckets, counts):
+            running += n
+            out.append((_fmt_float(bound), running))
+        out.append(("+Inf", running + counts[-1]))
+        return out
+
+
+def _fmt_float(v):
+    """Prometheus-friendly number rendering: 0.005, 1, 10 — no 1e-05."""
+    s = f"{v:.10f}".rstrip("0").rstrip(".")
+    return s if s else "0"
+
+
+class Registry:
+    """Get-or-create home for named instruments."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments = {}
+
+    def _get_or_create(self, cls, name, help, **kwargs):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, help=help, **kwargs)
+                self._instruments[name] = inst
+        if not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(inst).__name__}, requested {cls.__name__}"
+            )
+        return inst
+
+    def counter(self, name, help="") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help="") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def reset(self):
+        with self._lock:
+            self._instruments.clear()
+
+    # ------------------------------------------------------------ exporters
+
+    def snapshot(self) -> dict:
+        """JSON-safe dict of every instrument's current state."""
+        with self._lock:
+            items = list(self._instruments.items())
+        out = {}
+        for name, inst in sorted(items):
+            if isinstance(inst, Counter):
+                out[name] = {"type": "counter", "value": inst.value}
+            elif isinstance(inst, Gauge):
+                out[name] = {"type": "gauge", "value": inst.value}
+            else:
+                out[name] = {
+                    "type": "histogram",
+                    "sum": inst.sum,
+                    "count": inst.count,
+                    "buckets": {le: n for le, n in inst.cumulative()},
+                }
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (v0.0.4)."""
+        with self._lock:
+            items = list(self._instruments.items())
+        lines = []
+        for name, inst in sorted(items):
+            kind = ("counter" if isinstance(inst, Counter)
+                    else "gauge" if isinstance(inst, Gauge)
+                    else "histogram")
+            if inst.help:
+                lines.append(f"# HELP {name} {inst.help}")
+            lines.append(f"# TYPE {name} {kind}")
+            if kind == "histogram":
+                for le, n in inst.cumulative():
+                    lines.append(f'{name}_bucket{{le="{le}"}} {n}')
+                lines.append(f"{name}_sum {_fmt_float(inst.sum)}")
+                lines.append(f"{name}_count {inst.count}")
+            else:
+                lines.append(f"{name} {_fmt_float(inst.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_jsonl(self, path, extra=None) -> str:
+        """Append one snapshot line to ``path``; returns the path."""
+        record = dict(extra or {})
+        record["metrics"] = self.snapshot()
+        with open(path, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+        return path
+
+    def to_scalar_logger(self, logger, step) -> None:
+        """Bridge into ``utils.tb.ScalarLogger``: counters/gauges as-is,
+        histograms as ``<name>_sum``/``<name>_count``."""
+        scalars = {}
+        for name, payload in self.snapshot().items():
+            if payload["type"] == "histogram":
+                scalars[f"{name}_sum"] = payload["sum"]
+                scalars[f"{name}_count"] = payload["count"]
+            else:
+                scalars[name] = payload["value"]
+        if scalars:
+            logger.log(step, **scalars)
+
+    def phase_breakdown(self) -> dict:
+        """Seconds spent per phase, from the ``phase_*_seconds`` histograms
+        that span exits feed.  Always contains the canonical four keys."""
+        with self._lock:
+            items = list(self._instruments.items())
+        out = {p: 0.0 for p in PHASES}
+        for name, inst in items:
+            if (isinstance(inst, Histogram) and name.startswith("phase_")
+                    and name.endswith("_seconds")):
+                out[name[len("phase_"):-len("_seconds")]] = inst.sum
+        return out
+
+
+# Process-global registry: one scrape surface per process, like the tracer.
+metrics = Registry()
+
+_JAX_HOOKS_INSTALLED = False
+
+
+def install_jax_hooks(registry=None) -> bool:
+    """Register ``jax.monitoring`` listeners that count compiles/retraces.
+
+    Idempotent; returns False when jax (or its monitoring module) is absent.
+    Listeners are permanent per jax's API, so they consult ``enabled()`` at
+    event time rather than registration time.
+    """
+    global _JAX_HOOKS_INSTALLED
+    if _JAX_HOOKS_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+    reg = registry if registry is not None else metrics
+
+    def _on_event(event, **kw):
+        if not runtime.enabled():
+            return
+        if "compil" in event or "trace" in event:
+            reg.counter(
+                "jax_compiles_total",
+                help="jax.monitoring compile/trace events observed",
+            ).inc()
+
+    def _on_duration(event, duration=0.0, **kw):
+        if not runtime.enabled():
+            return
+        if "compil" in event or "trace" in event:
+            reg.histogram(
+                "jax_compile_seconds",
+                help="duration of jax compile/trace events",
+            ).observe(duration)
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _JAX_HOOKS_INSTALLED = True
+    return True

@@ -1,0 +1,78 @@
+"""Step-windowed ``jax.profiler`` capture.
+
+Opt-in via ``DISTKERAS_PROFILE=<dir>`` (plus optional
+``DISTKERAS_PROFILE_STEPS=<start>:<stop>``, default ``1:2`` — skip epoch 0
+so compile noise stays out of the capture).  The trainer calls
+``on_step(epoch)`` at the top of each epoch and ``close()`` when done; the
+hook starts/stops ``jax.profiler`` exactly once over the half-open window
+``[start, stop)``.
+
+jax is imported lazily so this module stays importable (and testable by
+monkeypatching ``_start``/``_stop``) on hosts without a backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["ProfilerHook"]
+
+
+class ProfilerHook:
+    """Start/stop ``jax.profiler`` over a step (epoch) range."""
+
+    def __init__(self, logdir, start_step=1, stop_step=None):
+        self.logdir = logdir
+        self.start_step = int(start_step)
+        self.stop_step = int(stop_step) if stop_step is not None else self.start_step + 1
+        if self.stop_step <= self.start_step:
+            raise ValueError("stop_step must be > start_step")
+        self.active = False
+        self.done = False
+
+    @classmethod
+    def from_env(cls):
+        """Build from ``DISTKERAS_PROFILE`` / ``DISTKERAS_PROFILE_STEPS``;
+        None when profiling is not requested."""
+        logdir = os.environ.get("DISTKERAS_PROFILE")
+        if not logdir:
+            return None
+        steps = os.environ.get("DISTKERAS_PROFILE_STEPS", "1:2")
+        try:
+            lo, _, hi = steps.partition(":")
+            start, stop = int(lo), int(hi) if hi else int(lo) + 1
+        except ValueError:
+            raise ValueError(
+                f"DISTKERAS_PROFILE_STEPS must be 'start:stop', got {steps!r}"
+            ) from None
+        return cls(logdir, start, stop)
+
+    # Separated so tests can monkeypatch without a real profiler session.
+    def _start(self):
+        import jax
+
+        os.makedirs(self.logdir, exist_ok=True)
+        jax.profiler.start_trace(self.logdir)
+
+    def _stop(self):
+        import jax
+
+        jax.profiler.stop_trace()
+
+    def on_step(self, step) -> None:
+        """Call at the top of each step/epoch with its index."""
+        if self.active and step >= self.stop_step:
+            self._stop()
+            self.active = False
+            self.done = True
+        if (not self.active and not self.done
+                and self.start_step <= step < self.stop_step):
+            self._start()
+            self.active = True
+
+    def close(self) -> None:
+        """Stop the capture if the run ended inside the window."""
+        if self.active:
+            self._stop()
+            self.active = False
+            self.done = True

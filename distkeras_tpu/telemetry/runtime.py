@@ -1,0 +1,55 @@
+"""Telemetry on/off switch and output-directory resolution.
+
+The whole subsystem is opt-in: it activates only when ``DISTKERAS_TELEMETRY``
+is set to a non-empty value other than ``0``.  A value of ``1``/``true``
+enables with the default output directory; any other value enables AND names
+the output directory (``DISTKERAS_TELEMETRY=/tmp/run1``), with
+``DISTKERAS_TELEMETRY_DIR`` as the explicit override.
+
+``enabled()`` is the fast path consulted by every instrumentation site, so it
+must cost no more than a module-global read plus an ``is None`` check once
+the cached value is warm.  Tests flip the switch with ``configure()`` instead
+of mutating ``os.environ``.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["configure", "enabled", "out_dir"]
+
+_FALSEY = ("", "0", "false", "no")
+
+# None = not yet resolved from the environment; True/False once resolved or
+# forced via configure().
+_ENABLED = None
+
+
+def enabled() -> bool:
+    """True when telemetry recording is on.  Cached after first read."""
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = os.environ.get("DISTKERAS_TELEMETRY", "").lower() not in _FALSEY
+    return _ENABLED
+
+
+def configure(on=None) -> None:
+    """Force telemetry on/off (``True``/``False``) or reset to env-driven
+    (``None``, re-read lazily on the next ``enabled()`` call)."""
+    global _ENABLED
+    _ENABLED = on
+
+
+def out_dir() -> str:
+    """Directory where ``flush()`` writes trace/metrics files.
+
+    Priority: ``DISTKERAS_TELEMETRY_DIR``, then a path-valued
+    ``DISTKERAS_TELEMETRY``, then ``./distkeras_telemetry``.
+    """
+    explicit = os.environ.get("DISTKERAS_TELEMETRY_DIR")
+    if explicit:
+        return explicit
+    v = os.environ.get("DISTKERAS_TELEMETRY", "")
+    if v.lower() not in _FALSEY + ("1", "true", "yes"):
+        return v
+    return "distkeras_telemetry"

@@ -1,0 +1,164 @@
+"""Span tracer exporting Chrome trace-event JSON (Perfetto-loadable).
+
+Usage at a host boundary (never inside jitted code):
+
+    with telemetry.trace.span("epoch", epoch=3):
+        with telemetry.trace.span("window", phase="step"):
+            ...
+
+Spans clock with ``time.perf_counter`` (monotonic — DK106's whole point),
+nest per-thread, and are recorded as complete ("ph": "X") events whose
+ts/dur containment gives Perfetto the nesting; each event also carries an
+explicit ``args.parent`` so tests and scripts need no interval math.
+
+When telemetry is disabled, ``span()`` returns a shared no-op context
+manager — the cost is one cached-bool check and one dict-free branch, which
+the test suite pins against plain dict-lookup cost.
+
+A span opened with ``phase="step"`` (or data/h2d/commit/...) additionally
+feeds the ``phase_<name>_seconds`` histogram in the global metrics registry
+on exit — that is where bench.py's phase breakdown comes from.
+
+Exceptions raised while recording are NOT swallowed: the CI tier-1 variant
+with ``DISTKERAS_TELEMETRY=1`` exists precisely so instrumentation bugs fail
+the build instead of silently disabling observability.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from distkeras_tpu.telemetry import runtime
+from distkeras_tpu.telemetry.metrics import metrics as _registry
+
+__all__ = ["Span", "Tracer", "trace"]
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """Context manager recording one complete trace event on exit."""
+
+    __slots__ = ("_tracer", "name", "phase", "attrs", "_t0")
+
+    def __init__(self, tracer, name, phase, attrs):
+        self._tracer = tracer
+        self.name = name
+        self.phase = phase
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._tracer._push(self.name)
+        self._t0 = self._tracer._clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = self._tracer._clock()
+        parent = self._tracer._pop()
+        self._tracer._record(self.name, self._t0, t1, parent, self.attrs)
+        if self.phase is not None:
+            _registry.histogram(
+                f"phase_{self.phase}_seconds",
+                help=f"host-visible seconds in the {self.phase} phase",
+            ).observe(t1 - self._t0)
+        return False
+
+
+class Tracer:
+    """Thread-safe span recorder with Chrome trace-event export.
+
+    ``clock`` and ``pid`` are injectable so golden-file tests are
+    deterministic; production code uses the module-global :data:`trace`.
+    """
+
+    def __init__(self, clock=time.perf_counter, pid=None):
+        self._clock = clock
+        self._pid = pid
+        self._lock = threading.Lock()
+        self._events = []
+        self._tls = threading.local()
+        self._tids = {}
+        self._origin = clock()
+
+    # ------------------------------------------------------------- recording
+
+    def span(self, name, phase=None, **attrs):
+        if not runtime.enabled():
+            return NOOP_SPAN
+        return Span(self, name, phase, attrs)
+
+    def _stack(self):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _push(self, name):
+        self._stack().append(name)
+
+    def _pop(self):
+        stack = self._stack()
+        stack.pop()
+        return stack[-1] if stack else None
+
+    def _record(self, name, t0, t1, parent, attrs):
+        ident = threading.get_ident()
+        args = dict(attrs)
+        if parent is not None:
+            args["parent"] = parent
+        with self._lock:
+            tid = self._tids.setdefault(ident, len(self._tids))
+            self._events.append({
+                "name": name,
+                "cat": "distkeras",
+                "ph": "X",
+                "pid": self._pid if self._pid is not None else os.getpid(),
+                "tid": tid,
+                "ts": round((t0 - self._origin) * 1e6, 3),
+                "dur": round((t1 - t0) * 1e6, 3),
+                "args": args,
+            })
+
+    def reset(self):
+        with self._lock:
+            self._events.clear()
+            self._tids.clear()
+            self._origin = self._clock()
+
+    # --------------------------------------------------------------- export
+
+    def events(self):
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def export(self) -> dict:
+        """Chrome trace-event JSON object; open in Perfetto / chrome://tracing."""
+        evs = self.events()
+        evs.sort(key=lambda e: (e["tid"], e["ts"], -e["dur"]))
+        return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+    def write(self, path) -> str:
+        payload = self.export()
+        with open(path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        return path
+
+
+# Process-global tracer used by all instrumentation sites.
+trace = Tracer()

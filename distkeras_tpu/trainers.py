@@ -27,6 +27,7 @@ from typing import Any, List, Optional, Sequence
 import jax
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu import workers as workers_mod
 from distkeras_tpu.data import epoch_arrays
 from distkeras_tpu.frame import DataFrame
@@ -284,7 +285,8 @@ class Trainer:
             from distkeras_tpu.ops.metrics import per_token_metric_names
 
             metrics = per_token_metric_names(metrics)
-        feats, labels = self._load_columns(dataframe)
+        with telemetry.trace.span("load_columns", phase="data"):
+            feats, labels = self._load_columns(dataframe)
         if self.pipeline_stages > 1:
             if self.tp_spec_fn is not None:
                 raise ValueError(
@@ -420,6 +422,12 @@ class Trainer:
             from distkeras_tpu.utils.tb import ScalarLogger
 
             scalar_log = ScalarLogger(self.tensorboard_dir)
+        # env-driven step-windowed jax.profiler capture; profile_dir (the
+        # explicit per-trainer knob below) takes precedence — both would
+        # race on one global profiler session
+        prof = None if self.profile_dir else telemetry.ProfilerHook.from_env()
+        if telemetry.enabled():
+            telemetry.install_jax_hooks()
 
         def _materialise(stats, epoch_idx):
             stats = jax.tree.map(np.asarray, stats)
@@ -432,96 +440,109 @@ class Trainer:
                         key = name if isinstance(name, str) else getattr(name, "__name__", f"metric_{i}")
                         scalars[key] = float(per_metric[i])
                 scalar_log.log(epoch_idx, **scalars)
+                if telemetry.enabled():
+                    telemetry.metrics.to_scalar_logger(scalar_log, epoch_idx)
             return stats
 
         epoch_stats: List[dict] = []
         self.record_training_start()
-        if self.streaming and commit_schedule is not None:
-            raise ValueError(
-                "streaming=True is incompatible with commit_schedule: the "
-                "staleness simulation scans the whole epoch in one program"
-            )
-        if self.dispatch_epochs > 1:
-            if self.streaming:
+        # try/finally so the scalar logger and profiler release their file
+        # handles / capture session even when an epoch raises (previously a
+        # failed epoch leaked the ScalarLogger's writer)
+        try:
+            if self.streaming and commit_schedule is not None:
                 raise ValueError(
-                    "dispatch_epochs>1 needs the whole epoch on device; "
-                    "streaming=True feeds it window by window"
+                    "streaming=True is incompatible with commit_schedule: the "
+                    "staleness simulation scans the whole epoch in one program"
                 )
-            if commit_schedule is not None:
-                raise ValueError(
-                    "dispatch_epochs>1 is incompatible with commit_schedule "
-                    "(the staleness simulation dispatches per epoch)"
-                )
-            state, epoch_stats = self._train_chunked(
-                engine, state, feats, labels, num_workers, window, shuffle,
-                ckpt, start_epoch, _materialise,
-            )
-            # all epochs consumed; the per-epoch loop below runs zero times
-            start_epoch = self.num_epoch
-        stream_window = window
-        if self.streaming and window is None:
-            # No-commit trainers (SingleTrainer/Ensemble) have no natural
-            # window; stream in fixed blocks with a ragged tail
-            # (pad_to_window=False below), so the step count — and therefore
-            # the trajectory — matches the in-memory path exactly.  The tail
-            # costs one extra compile; forcing divisor-sized blocks instead
-            # could degenerate to 1-step dispatches on prime step counts.
-            from distkeras_tpu.data import plan_epoch
-
-            steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
-            stream_window = min(steps, 32)
-        for epoch in range(start_epoch, self.num_epoch):
-            if self.streaming:
-                from distkeras_tpu.data import epoch_window_iter
-
-                blocks = epoch_window_iter(
-                    feats, labels, num_workers, self.batch_size, stream_window,
-                    rng=rng if shuffle else None,
-                    pad_to_window=window is not None,
-                    feature_dtype=self.compute_dtype,
-                )
-                run_one = lambda blocks=blocks: engine.run_epoch_streaming(state, blocks)
-            else:
-                if window is None:
-                    # single window spanning the whole epoch (no commits)
-                    from distkeras_tpu.data import plan_epoch
-
-                    steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
-                    xs, ys = epoch_arrays(
-                        feats, labels, num_workers, self.batch_size, steps,
-                        rng=rng if shuffle else None,
+            if self.dispatch_epochs > 1:
+                if self.streaming:
+                    raise ValueError(
+                        "dispatch_epochs>1 needs the whole epoch on device; "
+                        "streaming=True feeds it window by window"
                     )
-                else:
-                    xs, ys = epoch_arrays(
-                        feats, labels, num_workers, self.batch_size, window,
-                        stepwise=commit_schedule is not None,
-                        rng=rng if shuffle else None,
+                if commit_schedule is not None:
+                    raise ValueError(
+                        "dispatch_epochs>1 is incompatible with commit_schedule "
+                        "(the staleness simulation dispatches per epoch)"
                     )
-                xs, ys = engine.shard_batches(xs, ys)
-                run_one = lambda xs=xs, ys=ys: engine.run_epoch(state, xs, ys)
-            # Trace the second epoch (the first includes compilation), or the
-            # only epoch when there is just one.
-            if self.profile_dir and epoch == min(start_epoch + 1, self.num_epoch - 1):
-                with jax.profiler.trace(self.profile_dir):
-                    state, stats = run_one()
-                    jax.block_until_ready(state.center_params)
-            else:
-                state, stats = run_one()
-            # keep the current epoch's stats as device arrays: dispatch is
-            # async, so the next epoch's host-side batching overlaps this
-            # epoch's device compute.  Materialise the previous epoch's stats
-            # now (its compute is long done) so retention stays O(1).
+                state, epoch_stats = self._train_chunked(
+                    engine, state, feats, labels, num_workers, window, shuffle,
+                    ckpt, start_epoch, _materialise,
+                )
+                # all epochs consumed; the per-epoch loop below runs zero times
+                start_epoch = self.num_epoch
+            stream_window = window
+            if self.streaming and window is None:
+                # No-commit trainers (SingleTrainer/Ensemble) have no natural
+                # window; stream in fixed blocks with a ragged tail
+                # (pad_to_window=False below), so the step count — and therefore
+                # the trajectory — matches the in-memory path exactly.  The tail
+                # costs one extra compile; forcing divisor-sized blocks instead
+                # could degenerate to 1-step dispatches on prime step counts.
+                from distkeras_tpu.data import plan_epoch
+
+                steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
+                stream_window = min(steps, 32)
+            for epoch in range(start_epoch, self.num_epoch):
+                if prof is not None:
+                    prof.on_step(epoch)
+                with telemetry.trace.span("epoch", epoch=epoch):
+                    if self.streaming:
+                        from distkeras_tpu.data import epoch_window_iter
+
+                        blocks = epoch_window_iter(
+                            feats, labels, num_workers, self.batch_size, stream_window,
+                            rng=rng if shuffle else None,
+                            pad_to_window=window is not None,
+                            feature_dtype=self.compute_dtype,
+                        )
+                        run_one = lambda blocks=blocks: engine.run_epoch_streaming(state, blocks)
+                    else:
+                        if window is None:
+                            # single window spanning the whole epoch (no commits)
+                            from distkeras_tpu.data import plan_epoch
+
+                            steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
+                            xs, ys = epoch_arrays(
+                                feats, labels, num_workers, self.batch_size, steps,
+                                rng=rng if shuffle else None,
+                            )
+                        else:
+                            xs, ys = epoch_arrays(
+                                feats, labels, num_workers, self.batch_size, window,
+                                stepwise=commit_schedule is not None,
+                                rng=rng if shuffle else None,
+                            )
+                        xs, ys = engine.shard_batches(xs, ys)
+                        run_one = lambda xs=xs, ys=ys: engine.run_epoch(state, xs, ys)
+                    # Trace the second epoch (the first includes compilation),
+                    # or the only epoch when there is just one.
+                    if self.profile_dir and epoch == min(start_epoch + 1, self.num_epoch - 1):
+                        with jax.profiler.trace(self.profile_dir):
+                            state, stats = run_one()
+                            jax.block_until_ready(state.center_params)
+                    else:
+                        state, stats = run_one()
+                    # keep the current epoch's stats as device arrays: dispatch
+                    # is async, so the next epoch's host-side batching overlaps
+                    # this epoch's device compute.  Materialise the previous
+                    # epoch's stats now (its compute is long done) so retention
+                    # stays O(1).
+                    if epoch_stats:
+                        epoch_stats[-1] = _materialise(epoch_stats[-1], epoch - 1)
+                    epoch_stats.append(stats)
+                    if ckpt is not None:
+                        ckpt.maybe_save(state, epoch)
             if epoch_stats:
-                epoch_stats[-1] = _materialise(epoch_stats[-1], epoch - 1)
-            epoch_stats.append(stats)
+                epoch_stats[-1] = _materialise(epoch_stats[-1], self.num_epoch - 1)
             if ckpt is not None:
-                ckpt.maybe_save(state, epoch)
-        if epoch_stats:
-            epoch_stats[-1] = _materialise(epoch_stats[-1], self.num_epoch - 1)
-        if ckpt is not None:
-            ckpt.wait()  # flush in-flight async saves before declaring done
-        if scalar_log is not None:
-            scalar_log.close()
+                ckpt.wait()  # flush in-flight async saves before declaring done
+        finally:
+            if prof is not None:
+                prof.close()
+            if scalar_log is not None:
+                scalar_log.close()
         if average_at_end:
             state, _ = engine.average_workers(state)
 
@@ -537,6 +558,20 @@ class Trainer:
             if metrics_per_epoch:
                 key = name if isinstance(name, str) else getattr(name, "__name__", f"metric_{i}")
                 self.history[key] = [float(m[i]) for m in metrics_per_epoch]
+        if telemetry.enabled():
+            tt = self.get_training_time()
+            telemetry.metrics.gauge(
+                "training_seconds", help="wall seconds of the last fit"
+            ).set(tt)
+            if tt > 0 and epoch_stats:
+                telemetry.metrics.gauge(
+                    "samples_per_sec_per_chip",
+                    help="trained samples per second per device (last fit)",
+                ).set(len(epoch_stats) * len(feats) / tt
+                      / int(engine.mesh.devices.size))
+            # one file pair per process under DISTKERAS_TELEMETRY[_DIR]:
+            # the Chrome trace (open in Perfetto) and a metrics snapshot
+            telemetry.flush()
         return engine, state, adapter
 
     def _train_chunked(
@@ -590,17 +625,20 @@ class Trainer:
             # nothing, and the per-epoch loop has the same property at
             # num_epoch == 1).
             last_chunk = epoch + chunk >= self.num_epoch
-            if self.profile_dir and (
-                (chunk_idx == 1 and chunk == first_chunk_size)
-                or (chunk_idx == 0 and last_chunk)
-            ):
-                with jax.profiler.trace(self.profile_dir):
+            # "epoch" span per chunk dispatch (attrs carry how many epochs it
+            # covers) so chunked runs keep the epoch→window→commit nesting
+            with telemetry.trace.span("epoch", epoch=epoch, epochs=chunk):
+                if self.profile_dir and (
+                    (chunk_idx == 1 and chunk == first_chunk_size)
+                    or (chunk_idx == 0 and last_chunk)
+                ):
+                    with jax.profiler.trace(self.profile_dir):
+                        state, stats = engine.run_epochs(
+                            state, xs, ys, chunk, shuffle_seed=shuffle_seed)
+                        jax.block_until_ready(state.center_params)
+                else:
                     state, stats = engine.run_epochs(
                         state, xs, ys, chunk, shuffle_seed=shuffle_seed)
-                    jax.block_until_ready(state.center_params)
-            else:
-                state, stats = engine.run_epochs(
-                    state, xs, ys, chunk, shuffle_seed=shuffle_seed)
             # Same O(1)-retention scheme as the per-epoch loop: materialise
             # the previous chunk's stats (long computed) while this chunk's
             # stay device-resident.

@@ -17,30 +17,49 @@ __all__ = ["ScalarLogger"]
 
 
 class ScalarLogger:
-    """Append-only scalar sink: ``log(step, loss=..., accuracy=...)``."""
+    """Append-only scalar sink: ``log(step, loss=..., accuracy=...)``.
+
+    Usable as a context manager (``with ScalarLogger(d) as log:``) so the
+    underlying writer/file handle is released even when training raises.
+    ``close()`` is idempotent and safe when nothing was ever written: the
+    JSONL file opens lazily on the first ``log`` call.
+    """
 
     def __init__(self, logdir: str):
         self.logdir = os.path.abspath(logdir)
         os.makedirs(self.logdir, exist_ok=True)
         self._writer = None
+        self._jsonl = None
         self._write = self._write_jsonl
+        if self._try_torch():
+            self._write = self._write_torch
+        elif os.environ.get("DISTKERAS_TB_TF"):
+            # Opt-in only: initializing TensorFlow inside the live training
+            # process can preallocate accelerator memory / contend for
+            # libtpu — too big a side effect for a scalar logger to take on
+            # by default.  If TF turns out to be unimportable anyway, fall
+            # back to JSONL instead of failing the whole training run over
+            # a logging preference.
+            if self._try_tf():
+                self._write = self._write_tf
+
+    def _try_torch(self) -> bool:
         try:
             from torch.utils.tensorboard import SummaryWriter
 
             self._writer = SummaryWriter(self.logdir)
-            self._write = self._write_torch
+            return True
         except Exception:
-            if os.environ.get("DISTKERAS_TB_TF"):
-                # Opt-in only: initializing TensorFlow inside the live
-                # training process can preallocate accelerator memory /
-                # contend for libtpu — too big a side effect for a scalar
-                # logger to take on by default.
-                import tensorflow as tf
+            return False
 
-                self._writer = tf.summary.create_file_writer(self.logdir)
-                self._write = self._write_tf
-            else:
-                self._jsonl = open(os.path.join(self.logdir, "scalars.jsonl"), "a")
+    def _try_tf(self) -> bool:
+        try:
+            import tensorflow as tf
+
+            self._writer = tf.summary.create_file_writer(self.logdir)
+            return True
+        except Exception:
+            return False
 
     def _write_torch(self, step, scalars):
         for name, value in scalars.items():
@@ -56,6 +75,8 @@ class ScalarLogger:
         self._writer.flush()
 
     def _write_jsonl(self, step, scalars):
+        if self._jsonl is None:
+            self._jsonl = open(os.path.join(self.logdir, "scalars.jsonl"), "a")
         self._jsonl.write(json.dumps({"step": step, **scalars}) + "\n")
         self._jsonl.flush()
 
@@ -65,5 +86,14 @@ class ScalarLogger:
     def close(self) -> None:
         if self._writer is not None:
             self._writer.close()
-        elif hasattr(self, "_jsonl"):
+            self._writer = None
+        if self._jsonl is not None:
             self._jsonl.close()
+            self._jsonl = None
+
+    def __enter__(self) -> "ScalarLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False

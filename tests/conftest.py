@@ -31,6 +31,17 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _telemetry_files_to_tmp(tmp_path, monkeypatch):
+    """The CI matrix runs the whole suite with DISTKERAS_TELEMETRY=1; keep
+    each test's flush() output (trace_*.json / metrics_*.jsonl) out of the
+    repo checkout unless a test points the dir somewhere itself."""
+    if os.environ.get("DISTKERAS_TELEMETRY") and not os.environ.get(
+            "DISTKERAS_TELEMETRY_DIR"):
+        monkeypatch.setenv("DISTKERAS_TELEMETRY_DIR", str(tmp_path))
+    yield
+
+
 @pytest.fixture(scope="session")
 def toy_classification():
     """Small linearly-separable 2-class problem: fast convergence checks."""

@@ -1,0 +1,36 @@
+"""DK106 fixture: wall-clock time used for durations.  Parsed, never run."""
+
+import time
+
+
+def deadline_wait(timeout):
+    deadline = time.time() + timeout  # DK106: deadline arithmetic
+    while time.time() < deadline:  # DK106: deadline comparison
+        pass
+
+
+def measure():
+    t0 = time.time()  # not flagged alone: the subtraction below is the sin
+    do_work()
+    return time.time() - t0  # DK106: duration subtraction
+
+
+def nested_arithmetic():
+    return max(0.0, time.time() - START)  # DK106: flagged through nesting
+
+
+def suppressed(timeout):
+    end = time.time() + timeout  # dklint: disable=DK106
+    return end
+
+
+def timestamp_ok():
+    # bare timestamps are the legitimate wall-clock use: not flagged
+    stamp = time.time()
+    return {"created_at": time.time(), "stamp": stamp}
+
+
+def perf_counter_ok():
+    t0 = time.perf_counter()
+    do_work()
+    return time.perf_counter() - t0

@@ -35,6 +35,13 @@ def test_run_config_schema(monkeypatch):
     assert out["chips"] >= 1
     assert out["protocol"] == bench.PROTOCOL
     assert out["mfu"] is None  # CPU backend: no peak-FLOPs table entry
+    # the record must say where it ran and where the wall time went
+    assert out["platform"] == "cpu"
+    assert out["device_kind"]
+    assert set(out["phases"]) == {"data", "h2d", "step", "commit"}
+    assert all(v >= 0 for v in out["phases"].values())
+    assert out["phases"]["data"] > 0 and out["phases"]["step"] > 0
+    assert "platform_fallback" not in out  # no fallback happened here
     json.dumps(out)  # driver requires one JSON line
 
 
@@ -274,13 +281,73 @@ def test_emit_error_is_parseable_json(capsys):
     assert "TPU fell over" in parsed["error"]
 
 
-def test_main_emits_json_line_when_backend_unavailable(monkeypatch, capsys):
+def test_main_emits_json_line_when_even_cpu_fallback_fails(monkeypatch, capsys):
+    # Both the real backend AND the CPU fallback probe fail: only then may
+    # main() emit error verdicts (one parseable line per pending metric).
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(bench, "_PLATFORM_FALLBACK", None)
     monkeypatch.setattr(bench, "preflight", lambda **kw: {"error": "UNAVAILABLE: nope"})
     monkeypatch.setattr("sys.argv", ["bench.py"])
     bench.main()  # must not raise
     parsed = json.loads(capsys.readouterr().out.strip())
     assert parsed["value"] is None
     assert "UNAVAILABLE" in parsed["error"]
+    assert "CPU fallback also failed" in parsed["error"]
+
+
+def test_main_falls_back_to_cpu_smoke_when_backend_dies(monkeypatch, capsys):
+    """Dead TPU tunnel at launch: instead of an all-error run, main() flips
+    to a CPU mesh and measures smoke shapes — the emitted line is a real
+    measurement carrying platform + phases, not an error verdict."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(bench, "_PLATFORM_FALLBACK", None)
+    probes = []
+
+    def flaky_preflight(**kw):
+        probes.append(kw)
+        if len(probes) == 1:
+            return {"error": "UNAVAILABLE: tunnel died"}
+        return {"n": 8, "platform": "cpu", "kind": "cpu"}
+
+    seen_kw = {}
+
+    def fake_run_config(config, **kw):
+        seen_kw.update(kw)
+        return {"metric": f"{config}_samples_per_sec_per_chip", "value": 42.0,
+                "platform": "cpu", "phases": {}, "chips": 8,
+                "platform_fallback": bench._PLATFORM_FALLBACK}
+
+    monkeypatch.setattr(bench, "preflight", flaky_preflight)
+    monkeypatch.setattr(bench, "run_config", fake_run_config)
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    bench.main()
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed["status"] == "ok"
+    assert parsed["value"] == 42.0
+    assert "UNAVAILABLE" in parsed["platform_fallback"]
+    # the fallback retried the probe exactly once and shrank the shapes to
+    # the CPU smoke protocol
+    assert len(probes) == 2 and probes[1] == {"max_tries": 1}
+    assert seen_kw == dict(n_windows=1, reps=1, k=1, batch_override=16,
+                           window_override=2)
+
+
+def test_write_baseline_refused_on_cpu_smoke(monkeypatch, capsys, tmp_path):
+    # A CPU smoke run must never pin regression baselines.
+    monkeypatch.setattr(bench, "_PLATFORM_FALLBACK", None)
+    monkeypatch.setattr(bench, "BASELINE_FILE", str(tmp_path / "pins.json"))
+    monkeypatch.setattr(bench, "preflight",
+                        lambda **kw: {"n": 8, "platform": "cpu", "kind": "cpu"})
+    monkeypatch.setattr(
+        bench, "run_config",
+        lambda config, **kw: {"metric": "m", "value": 1.0})
+    monkeypatch.setattr("sys.argv", ["bench.py", "--write-baseline"])
+    bench.main()
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    refusal = [l for l in lines if l.get("metric") == "write_baseline"]
+    assert len(refusal) == 1
+    assert "refused" in refusal[0]["error"]
+    assert not (tmp_path / "pins.json").exists()
 
 
 def test_main_emits_json_line_when_config_raises(monkeypatch, capsys):

@@ -117,6 +117,26 @@ def test_dk105_exemptions_and_suppression():
     assert 39 not in lines  # class owns no lock
 
 
+def test_dk106_wallclock_fixture():
+    got, _ = _run("dk106_wallclock.py", ["DK106"])
+    assert got == [
+        ("DK106", 7),   # deadline = time.time() + timeout
+        ("DK106", 8),   # while time.time() < deadline
+        ("DK106", 15),  # time.time() - t0
+        ("DK106", 19),  # flagged through max(0.0, ...) nesting
+    ]
+
+
+def test_dk106_timestamps_and_suppression():
+    got, _ = _run("dk106_wallclock.py", ["DK106"])
+    lines = [ln for _, ln in got]
+    assert 13 not in lines  # bare t0 = time.time() assignment
+    assert 23 not in lines  # suppressed deadline
+    assert 29 not in lines  # bare timestamp assignment
+    assert 30 not in lines  # timestamp in a dict literal
+    assert 36 not in lines  # perf_counter duration is the blessed idiom
+
+
 # ------------------------------------------------------------ machinery
 
 def test_file_wide_suppression(tmp_path):
@@ -160,7 +180,9 @@ def test_baseline_cancels_and_reports_stale(tmp_path):
 
 
 def test_all_rules_registered():
-    assert sorted(all_rules()) == ["DK101", "DK102", "DK103", "DK104", "DK105"]
+    assert sorted(all_rules()) == [
+        "DK101", "DK102", "DK103", "DK104", "DK105", "DK106",
+    ]
 
 
 def test_baseline_entries_have_reasons():

@@ -1,0 +1,397 @@
+"""Telemetry subsystem tests: span nesting + thread safety, histogram
+bucketing, Chrome-trace / Prometheus golden files, the daemon ``metrics``
+verb round-trip, the disabled-path overhead pin, ScalarLogger lifecycle,
+and an end-to-end smoke train that must write a Perfetto-loadable trace
+with nested epoch→window→commit spans."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu import telemetry
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.job_deployment import Job, PunchcardServer
+from distkeras_tpu.models import MLP, FlaxModel
+from distkeras_tpu.telemetry.metrics import Registry
+from distkeras_tpu.telemetry.profiler import ProfilerHook
+from distkeras_tpu.telemetry.trace import NOOP_SPAN, Tracer
+from distkeras_tpu.utils.tb import ScalarLogger
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry(tmp_path, monkeypatch):
+    """Each test starts enabled with empty global tracer/registry and leaves
+    the process env-driven again.  Any flush() (the trainers do one per fit)
+    lands in tmp_path, never the checkout."""
+    monkeypatch.setenv("DISTKERAS_TELEMETRY_DIR", str(tmp_path))
+    telemetry.configure(True)
+    telemetry.trace.reset()
+    telemetry.metrics.reset()
+    yield
+    telemetry.trace.reset()
+    telemetry.metrics.reset()
+    telemetry.configure(None)
+
+
+def fake_clock():
+    """Deterministic clock: 0.0, 1.0, 2.0, ... — one tick per call."""
+    t = {"v": -1.0}
+
+    def clock():
+        t["v"] += 1.0
+        return t["v"]
+
+    return clock
+
+
+# ------------------------------------------------------------------- spans
+
+def test_span_nesting_parent_chain_and_containment():
+    tr = Tracer(clock=fake_clock(), pid=0)
+    with tr.span("epoch", epoch=0):
+        with tr.span("window"):
+            with tr.span("commit"):
+                pass
+    evs = {e["name"]: e for e in tr.export()["traceEvents"]}
+    assert evs["epoch"]["args"] == {"epoch": 0}
+    assert evs["window"]["args"]["parent"] == "epoch"
+    assert evs["commit"]["args"]["parent"] == "window"
+    for child, parent in (("window", "epoch"), ("commit", "window")):
+        c, p = evs[child], evs[parent]
+        assert p["ts"] <= c["ts"]
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"]
+
+
+def test_sibling_spans_share_parent_and_do_not_nest():
+    tr = Tracer(clock=fake_clock(), pid=0)
+    with tr.span("epoch"):
+        with tr.span("a"):
+            pass
+        with tr.span("b"):
+            pass
+    evs = {e["name"]: e for e in tr.export()["traceEvents"]}
+    assert evs["a"]["args"]["parent"] == "epoch"
+    assert evs["b"]["args"]["parent"] == "epoch"
+    # siblings are disjoint in time
+    assert evs["a"]["ts"] + evs["a"]["dur"] <= evs["b"]["ts"]
+
+
+def test_span_thread_safety():
+    tr = Tracer()
+    n_threads, n_spans = 8, 50
+    # all threads alive at once, else the OS reuses thread idents and the
+    # distinct-tid assertion below would be vacuous
+    barrier = threading.Barrier(n_threads)
+
+    def work(i):
+        barrier.wait()
+        for k in range(n_spans):
+            with tr.span(f"outer_{i}", k=k):
+                with tr.span(f"inner_{i}"):
+                    pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = tr.export()["traceEvents"]
+    assert len(evs) == n_threads * n_spans * 2
+    assert len({e["tid"] for e in evs}) == n_threads
+    assert all(e["dur"] >= 0 for e in evs)
+    # nesting is tracked per thread: every inner span's parent is its own
+    # thread's outer span, never another thread's
+    for e in evs:
+        if e["name"].startswith("inner_"):
+            assert e["args"]["parent"] == "outer_" + e["name"].split("_")[1]
+
+
+def test_exported_trace_is_json_loadable(tmp_path):
+    with telemetry.trace.span("epoch"):
+        pass
+    path = telemetry.trace.write(str(tmp_path / "trace.json"))
+    payload = json.load(open(path))
+    assert payload["traceEvents"][0]["name"] == "epoch"
+    assert payload["traceEvents"][0]["ph"] == "X"
+
+
+def test_disabled_span_is_shared_noop_and_cheap():
+    telemetry.configure(False)
+    s1 = telemetry.trace.span("x")
+    s2 = telemetry.trace.span("y", phase="step", attr=1)
+    assert s1 is s2 is NOOP_SPAN
+    with s1:
+        pass  # records nothing
+    telemetry.configure(True)
+    assert telemetry.trace.export()["traceEvents"] == []
+
+    # Overhead pin: the disabled path must stay within a small constant
+    # factor of a plain dict lookup (it is: one cached-bool check + returning
+    # a shared object).  Generous bound + absolute floor to stay unflaky on
+    # loaded CI machines.
+    telemetry.configure(False)
+    n = 20000
+    d = {"k": 1}
+    t0 = time.perf_counter()
+    for _ in range(n):
+        d.get("k")
+    dict_t = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        telemetry.trace.span("x")
+    span_t = time.perf_counter() - t0
+    assert span_t < max(100 * dict_t, 0.05), (
+        f"disabled span() cost {span_t:.4f}s vs dict lookup {dict_t:.4f}s"
+    )
+
+
+# ----------------------------------------------------------------- metrics
+
+def test_histogram_bucketing_le_semantics():
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(1.0, 2.0, 5.0))
+    for v in (0.5, 1.0, 2.5, 100.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(104.0)
+    # cumulative le buckets: 1.0 counts into le=1, 2.5 into le=5, 100 -> +Inf
+    assert h.cumulative() == [("1", 2), ("2", 2), ("5", 3), ("+Inf", 4)]
+
+
+def test_histogram_is_bounded():
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1,))
+    for _ in range(1000):
+        h.observe(9e9)
+    assert len(h.cumulative()) == 2  # one finite bucket + overflow, always
+
+
+def test_counter_gauge_and_type_conflict():
+    reg = Registry()
+    reg.counter("n").inc()
+    reg.counter("n").inc(2.5)
+    assert reg.counter("n").value == pytest.approx(3.5)
+    with pytest.raises(ValueError):
+        reg.counter("n").inc(-1)
+    reg.gauge("g").set(7)
+    assert reg.gauge("g").value == 7.0
+    with pytest.raises(TypeError):
+        reg.gauge("n")  # already a counter
+
+
+def test_phase_breakdown_always_has_canonical_keys():
+    assert telemetry.metrics.phase_breakdown() == {
+        "data": 0.0, "h2d": 0.0, "step": 0.0, "commit": 0.0,
+    }
+    with telemetry.trace.span("x", phase="step"):
+        pass
+    bd = telemetry.metrics.phase_breakdown()
+    assert bd["step"] > 0.0
+    assert set(bd) >= {"data", "h2d", "step", "commit"}
+
+
+def test_registry_write_jsonl(tmp_path):
+    telemetry.metrics.counter("c").inc(2)
+    path = telemetry.metrics.write_jsonl(str(tmp_path / "m.jsonl"),
+                                         extra={"run": 1})
+    line = json.loads(open(path).read().splitlines()[-1])
+    assert line["run"] == 1
+    assert line["metrics"]["c"] == {"type": "counter", "value": 2.0}
+
+
+def test_registry_to_scalar_logger_bridge(tmp_path, monkeypatch):
+    monkeypatch.setattr(ScalarLogger, "_try_torch", lambda self: False)
+    telemetry.metrics.counter("commits_total").inc(4)
+    telemetry.metrics.histogram("lat", buckets=(1.0,)).observe(0.5)
+    with ScalarLogger(str(tmp_path)) as log:
+        telemetry.metrics.to_scalar_logger(log, step=3)
+    rec = json.loads(open(tmp_path / "scalars.jsonl").read().splitlines()[-1])
+    assert rec["step"] == 3
+    assert rec["commits_total"] == 4.0
+    assert rec["lat_sum"] == pytest.approx(0.5)
+    assert rec["lat_count"] == 1
+
+
+# ------------------------------------------------------------ golden files
+
+def test_chrome_trace_golden():
+    tr = Tracer(clock=fake_clock(), pid=0)
+    with tr.span("epoch", epoch=0):
+        with tr.span("window", windows=2):
+            with tr.span("step", phase=None):
+                pass
+            with tr.span("commit"):
+                pass
+    golden = json.load(open(os.path.join(GOLDEN, "telemetry_trace.json")))
+    assert tr.export() == golden
+
+
+def test_prometheus_golden():
+    reg = Registry()
+    reg.counter("jax_compiles_total", help="compile events").inc(3)
+    reg.gauge("samples_per_sec_per_chip").set(1234.5)
+    h = reg.histogram("phase_step_seconds", help="step phase",
+                      buckets=(0.001, 0.01, 0.1))
+    h.observe(0.0005)
+    h.observe(0.05)
+    golden = open(os.path.join(GOLDEN, "telemetry_prometheus.txt")).read()
+    assert reg.to_prometheus() == golden
+
+
+# -------------------------------------------------------- daemon round-trip
+
+@pytest.fixture
+def punchcard():
+    server = PunchcardServer(port=0, secret="s3cret")
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_daemon_metrics_verb_roundtrip(punchcard):
+    telemetry.metrics.counter("commits_total").inc(5)
+    telemetry.metrics.histogram("lat", buckets=(1.0,)).observe(0.25)
+    reply = Job("127.0.0.1", punchcard.port, secret="s3cret").metrics()
+    assert reply["status"] == "ok"
+    assert reply["enabled"] is True
+    assert "commits_total 5" in reply["prometheus"]
+    assert 'lat_bucket{le="1"} 1' in reply["prometheus"]
+    assert reply["snapshot"]["commits_total"] == {"type": "counter", "value": 5.0}
+    assert reply["snapshot"]["lat"]["count"] == 1
+
+
+def test_daemon_metrics_verb_requires_secret(punchcard):
+    reply = Job("127.0.0.1", punchcard.port, secret="wrong").metrics()
+    assert reply["status"] == "denied"
+
+
+# ------------------------------------------------------------- ScalarLogger
+
+def test_scalar_logger_context_manager_closes_on_error(tmp_path, monkeypatch):
+    monkeypatch.setattr(ScalarLogger, "_try_torch", lambda self: False)
+    with pytest.raises(RuntimeError):
+        with ScalarLogger(str(tmp_path)) as log:
+            log.log(0, loss=1.0)
+            raise RuntimeError("boom")
+    assert log._jsonl is None  # closed despite the exception
+    rec = json.loads(open(tmp_path / "scalars.jsonl").read().splitlines()[0])
+    assert rec == {"step": 0, "loss": 1.0}
+
+
+def test_scalar_logger_tf_fallback_to_jsonl(tmp_path, monkeypatch):
+    monkeypatch.setenv("DISTKERAS_TB_TF", "1")
+    monkeypatch.setattr(ScalarLogger, "_try_torch", lambda self: False)
+    monkeypatch.setattr(ScalarLogger, "_try_tf", lambda self: False)
+    log = ScalarLogger(str(tmp_path))  # must not raise
+    log.log(1, loss=0.5)
+    log.close()
+    assert (tmp_path / "scalars.jsonl").exists()
+
+
+def test_scalar_logger_close_idempotent_when_never_wrote(tmp_path, monkeypatch):
+    monkeypatch.setattr(ScalarLogger, "_try_torch", lambda self: False)
+    log = ScalarLogger(str(tmp_path))
+    log.close()
+    log.close()  # idempotent
+    assert not (tmp_path / "scalars.jsonl").exists()  # lazy open: no file
+
+
+# ---------------------------------------------------------------- profiler
+
+def test_profiler_hook_windowing(monkeypatch):
+    calls = []
+    monkeypatch.setattr(ProfilerHook, "_start", lambda self: calls.append("start"))
+    monkeypatch.setattr(ProfilerHook, "_stop", lambda self: calls.append("stop"))
+    hook = ProfilerHook("/tmp/prof", start_step=1, stop_step=3)
+    for step in range(5):
+        hook.on_step(step)
+    hook.close()
+    assert calls == ["start", "stop"]  # started at 1, stopped entering 3
+    assert hook.done
+
+
+def test_profiler_hook_close_stops_midwindow(monkeypatch):
+    calls = []
+    monkeypatch.setattr(ProfilerHook, "_start", lambda self: calls.append("start"))
+    monkeypatch.setattr(ProfilerHook, "_stop", lambda self: calls.append("stop"))
+    hook = ProfilerHook("/tmp/prof", start_step=0)
+    hook.on_step(0)
+    hook.close()
+    assert calls == ["start", "stop"]
+
+
+def test_profiler_from_env(monkeypatch, tmp_path):
+    assert ProfilerHook.from_env() is None
+    monkeypatch.setenv("DISTKERAS_PROFILE", str(tmp_path))
+    monkeypatch.setenv("DISTKERAS_PROFILE_STEPS", "2:4")
+    hook = ProfilerHook.from_env()
+    assert (hook.logdir, hook.start_step, hook.stop_step) == (str(tmp_path), 2, 4)
+
+
+# ------------------------------------------------------------- end to end
+
+def _train(toy, num_epoch=2, **kwargs):
+    x, y, onehot = toy
+    t = dk.DOWNPOUR(FlaxModel(MLP(features=(16,), num_classes=2)),
+                    loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=4, batch_size=16, num_epoch=num_epoch,
+                    communication_window=4, seed=7, **kwargs)
+    t.train(from_numpy(x, onehot))
+    return t
+
+
+def test_trajectory_unchanged_by_telemetry(toy_classification):
+    telemetry.configure(False)
+    base = _train(toy_classification).get_history()["loss"]
+    telemetry.configure(True)
+    telemetry.trace.reset()
+    telemetry.metrics.reset()
+    instrumented = _train(toy_classification).get_history()["loss"]
+    assert instrumented == base  # bit-identical: same program, same inputs
+
+
+def test_smoke_train_writes_nested_chrome_trace(toy_classification, tmp_path,
+                                                monkeypatch):
+    monkeypatch.setenv("DISTKERAS_TELEMETRY_DIR", str(tmp_path))
+    _train(toy_classification)
+
+    traces = [f for f in os.listdir(tmp_path) if f.startswith("trace_")]
+    assert len(traces) == 1
+    payload = json.load(open(tmp_path / traces[0]))  # must json.load cleanly
+    events = payload["traceEvents"]
+    parents = {e["name"]: e["args"].get("parent") for e in events}
+    # the acceptance nesting: epoch -> window -> commit
+    assert parents["window"] == "epoch"
+    assert parents["commit"] == "window"
+    epochs = [e for e in events if e["name"] == "epoch"]
+    assert [e["args"]["epoch"] for e in epochs] == [0, 1]
+    # containment in time, not just labels: the first window sits inside
+    # the first epoch
+    w = min((e for e in events if e["name"] == "window"), key=lambda e: e["ts"])
+    ep = epochs[0]
+    assert ep["ts"] <= w["ts"] and w["ts"] + w["dur"] <= ep["ts"] + ep["dur"]
+
+    metrics_files = [f for f in os.listdir(tmp_path) if f.startswith("metrics_")]
+    assert len(metrics_files) == 1
+    snap = json.loads(open(tmp_path / metrics_files[0]).read().splitlines()[-1])
+    bd = {k: v for k, v in snap["metrics"].items() if k.startswith("phase_")}
+    # the four bench phases all saw time during an in-memory train
+    assert {"phase_data_seconds", "phase_h2d_seconds", "phase_step_seconds",
+            "phase_commit_seconds"} <= set(bd)
+    assert snap["metrics"]["training_seconds"]["value"] > 0
+    assert snap["metrics"]["samples_per_sec_per_chip"]["value"] > 0
+
+
+def test_streaming_train_records_spans(toy_classification):
+    _train(toy_classification, num_epoch=1, streaming=True)
+    names = {e["name"] for e in telemetry.trace.export()["traceEvents"]}
+    # streaming records its real sync points instead of window/step/commit
+    assert {"epoch", "window_dispatch", "h2d", "window_gather"} <= names

@@ -6,4 +6,5 @@ from tools.dklint.checkers import (  # noqa: F401 — registration side effects
     locks,
     mesh_axes,
     recompile,
+    wallclock,
 )

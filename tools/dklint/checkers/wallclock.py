@@ -1,0 +1,73 @@
+"""DK106 — wall-clock ``time.time()`` used in duration arithmetic.
+
+``time.time()`` follows the system clock, which NTP slews and steps at will:
+a duration computed from two wall-clock reads can come out negative or off
+by the adjustment, and a deadline built as ``time.time() + timeout`` moves
+when the clock does.  Duration and deadline math must use
+``time.perf_counter()`` (finest resolution) or ``time.monotonic()``
+(cheap, deadline-grade).
+
+Heuristic: a ``time.time()`` call is flagged when its value visibly enters
+arithmetic or a comparison —
+
+* an operand of a ``BinOp`` (``time.time() - t0``, ``time.time() + timeout``),
+* an operand of a ``Compare`` (``while time.time() < deadline``),
+
+in either case directly or through any expression nesting (``max(0.0,
+time.time() - t0)`` flags).  A bare timestamp — stored, logged, formatted,
+returned — is the legitimate use of wall-clock time and stays unflagged, so
+the checker walks up the parent chain only through expression nodes and
+stops at statements.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+
+WALLCLOCK_CALLS = {"time.time"}
+
+# Parent-chain walk stops at these: reaching one without having crossed a
+# BinOp/Compare means the value is used as a plain timestamp.
+_STOP_NODES = (ast.stmt, ast.comprehension, ast.keyword)
+
+
+@register
+class WallClockDurations(Checker):
+    rule = "DK106"
+    name = "wallclock-duration"
+    description = (
+        "time.time() used in duration/deadline arithmetic; "
+        "use time.perf_counter() or time.monotonic()"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(fi.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in WALLCLOCK_CALLS:
+                continue
+            cur = parents.get(node)
+            while cur is not None and not isinstance(cur, _STOP_NODES):
+                if isinstance(cur, (ast.BinOp, ast.Compare)):
+                    yield Finding(
+                        path=fi.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.rule,
+                        message=(
+                            "time.time() feeds duration/deadline arithmetic; "
+                            "wall clocks jump under NTP — use "
+                            "time.perf_counter() (or time.monotonic() for "
+                            "coarse deadlines)"
+                        ),
+                    )
+                    break
+                cur = parents.get(cur)
