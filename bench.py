@@ -849,6 +849,16 @@ def _run_config_instrumented(config, n_windows, reps, k, num_workers,
         "phases": {name: round(secs, 3) for name, secs
                    in telemetry.metrics.phase_breakdown().items()},
     }
+    if isinstance(stats, dict) and "dynamics" in stats:
+        # DISTKERAS_DYNAMICS=1 run: put the health gauges (grad/update
+        # norms, worker<->center divergence, staleness) next to the cost
+        # breakdown, and into the registry so the emitted metrics JSONL
+        # carries them too.  Summarised after the timed sets — the arrays
+        # were already materialised by the final block_until_ready.
+        summary = telemetry.dynamics.summarize(stats["dynamics"],
+                                               loss=stats["loss"])
+        telemetry.dynamics.record_gauges(summary)
+        out["dynamics"] = {k: round(v, 6) for k, v in summary.items()}
     if _PLATFORM_FALLBACK:
         out["platform_fallback"] = _PLATFORM_FALLBACK
     out.update(_vs_baseline_fields(config, sps_per_chip))

@@ -28,6 +28,20 @@ class Adag(UpdateRule):
     def init_local_state(self, params):
         return {"anchor": params}
 
+    def dynamics(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
+        """Expose the accumulation state: the norm of the residual gathered
+        since the anchor and the ``1/steps_in_window`` normaliser it will be
+        scaled by at commit."""
+        import jax.numpy as jnp
+
+        from distkeras_tpu.telemetry.dynamics import tree_sq_dist
+
+        del center_params, center_state
+        return {
+            "rule_accum_norm": jnp.sqrt(tree_sq_dist(local_params, local_state["anchor"])),
+            "rule_accum_steps": ctx.steps_in_window,
+        }
+
     def commit(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
         inv_w = 1.0 / ctx.steps_in_window
         residual = jax.tree.map(

@@ -37,6 +37,16 @@ class DynSGD(UpdateRule):
     def init_local_state(self, params):
         return {"anchor": params, "clock": jnp.zeros((), jnp.int32)}
 
+    def dynamics(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
+        """Expose the staleness the next commit will damp by: the gap between
+        the replicated update counter and this worker's clock (and the
+        resulting ``1/(staleness+1)`` scale) — the quantity DynSGD's whole
+        design turns on, previously invisible outside the jitted program."""
+        del ctx, local_params, center_params
+        staleness = (center_state["num_updates"] - local_state["clock"]).astype(jnp.float32)
+        return {"rule_staleness": staleness,
+                "rule_scale": 1.0 / (staleness + 1.0)}
+
     def commit(self, ctx: CommitCtx, local_params, center_params, local_state, center_state):
         num_updates = center_state["num_updates"]
         staleness = (num_updates - local_state["clock"]).astype(jnp.float32)

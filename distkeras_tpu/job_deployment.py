@@ -14,7 +14,9 @@ so deploy only inside a trusted cluster, exactly like the reference.
 
 from __future__ import annotations
 
+import glob
 import hmac
+import json
 import os
 import socket
 import subprocess
@@ -24,11 +26,44 @@ import threading
 import uuid
 from typing import Any, Dict, Optional
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.networking import connect, recv_data, send_data
 
 __all__ = ["Job", "PunchcardServer"]
 
 DEFAULT_PORT = 8000
+
+
+def _collect_job_snapshot(tel_dir: str) -> Optional[dict]:
+    """The last metrics snapshot from each ``metrics_*.jsonl`` a job wrote
+    (one file per process), merged across its processes.  Returns ``None``
+    when the job emitted no telemetry.  Dynamics-series lines (which carry
+    no ``metrics`` key) are skipped — the snapshot line is the scrape
+    surface; the series stay in the job's JSONL for offline analysis."""
+    snaps = []
+    for path in sorted(glob.glob(os.path.join(tel_dir, "metrics_*.jsonl"))):
+        last = None
+        try:
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and "metrics" in rec:
+                        last = rec["metrics"]
+        except OSError:
+            continue
+        if last:
+            snaps.append(last)
+    if not snaps:
+        return None
+    from distkeras_tpu.telemetry.metrics import merge_snapshots
+
+    return merge_snapshots(snaps) if len(snaps) > 1 else snaps[0]
 
 
 class PunchcardServer:
@@ -69,6 +104,10 @@ class PunchcardServer:
             except OSError:
                 pass
             self._sock.close()
+        # the daemon often outlives any single fit and may be killed rather
+        # than exit cleanly — write its trace/metrics now, not at interpreter
+        # exit (no-op when telemetry is disabled)
+        telemetry.flush()
 
     # -- server internals ---------------------------------------------------
     def _accept_loop(self) -> None:
@@ -96,7 +135,7 @@ class PunchcardServer:
                 job_id = uuid.uuid4().hex
                 with self._cv:
                     self.jobs[job_id] = {"status": "queued", "output": "",
-                                         "returncode": None,
+                                         "returncode": None, "metrics": None,
                                          "script": msg["script"],
                                          "args": msg.get("args", [])}
                     self._queue.append(job_id)
@@ -115,13 +154,15 @@ class PunchcardServer:
             elif action == "metrics":
                 # Control-plane scrape of this process's telemetry registry:
                 # Prometheus text (for scrapers / humans) plus the structured
-                # snapshot, both JSON-safe for the restricted codec.
-                from distkeras_tpu import telemetry
-
+                # snapshot, both JSON-safe for the restricted codec — and the
+                # merged whole-fleet view of every job that reported metrics.
                 send_data(conn, {"status": "ok",
                                  "enabled": telemetry.enabled(),
                                  "prometheus": telemetry.metrics.to_prometheus(),
-                                 "snapshot": telemetry.metrics.snapshot()})
+                                 "snapshot": telemetry.metrics.snapshot(),
+                                 "fleet": self._fleet_snapshot()})
+            elif action == "aggregate":
+                send_data(conn, {"status": "ok", **self._fleet_snapshot()})
             else:
                 send_data(conn, {"status": "bad_request"})
         except (ConnectionError, ValueError, OSError):
@@ -142,16 +183,56 @@ class PunchcardServer:
             script_path = os.path.join(self.workdir, f"{job_id}.py")
             with open(script_path, "w") as f:
                 f.write(job["script"])
+            env = None
+            tel_dir = None
+            if telemetry.enabled():
+                # each job writes telemetry into its own subdirectory so the
+                # daemon can pick up the finished snapshot for fleet
+                # aggregation (the ``aggregate`` verb) without jobs
+                # clobbering each other's files
+                tel_dir = os.path.join(self.workdir, "telemetry", job_id)
+                os.makedirs(tel_dir, exist_ok=True)
+                env = dict(os.environ, DISTKERAS_TELEMETRY="1",
+                           DISTKERAS_TELEMETRY_DIR=tel_dir)
             try:
                 proc = subprocess.run(
                     [sys.executable, script_path, *map(str, job["args"])],
                     capture_output=True, text=True, timeout=3600, cwd=self.workdir,
+                    env=env,
                 )
                 job["output"] = proc.stdout + proc.stderr
                 job["returncode"] = proc.returncode
-                job["status"] = "finished" if proc.returncode == 0 else "failed"
+                outcome = "finished" if proc.returncode == 0 else "failed"
             except subprocess.TimeoutExpired:
-                job["status"] = "timeout"
+                outcome = "timeout"
+            if tel_dir is not None:
+                job["metrics"] = _collect_job_snapshot(tel_dir)
+            if telemetry.enabled():
+                telemetry.metrics.counter(
+                    "punchcard_jobs_finished_total" if outcome == "finished"
+                    else "punchcard_jobs_failed_total",
+                    help="jobs the runner completed, by outcome",
+                ).inc()
+                # flush per job: fleet runs must not lose telemetry that
+                # would otherwise only be written at interpreter exit
+                telemetry.flush()
+            # status last: clients poll it as the completion signal, so the
+            # job's fleet snapshot must already be in place when it flips
+            job["status"] = outcome
+
+    def _fleet_snapshot(self) -> dict:
+        """Merged metric snapshot across every job that reported metrics —
+        whole-fleet health in one scrape (``aggregate`` verb)."""
+        from distkeras_tpu.telemetry.metrics import (
+            merge_snapshots,
+            prometheus_from_snapshot,
+        )
+
+        with self._cv:
+            snaps = [j["metrics"] for j in self.jobs.values() if j.get("metrics")]
+        merged = merge_snapshots(snaps)
+        return {"jobs": len(snaps), "snapshot": merged,
+                "prometheus": prometheus_from_snapshot(merged)}
 
 
 class Job:
@@ -190,8 +271,16 @@ class Job:
     def metrics(self) -> dict:
         """Scrape the daemon's telemetry registry (``metrics`` verb):
         ``{"status": "ok", "enabled": ..., "prometheus": <text>,
-        "snapshot": {...}}``."""
+        "snapshot": {...}, "fleet": {"jobs": N, "snapshot": <merged>,
+        "prometheus": <text>}}`` — ``fleet`` is the whole-fleet merge of
+        every finished job's metric snapshot."""
         return self._rpc({"action": "metrics"})
+
+    def aggregate(self) -> dict:
+        """Fleet-wide metric merge only (``aggregate`` verb): counters
+        summed, gauges max'd (mean alongside), histograms merged on their
+        bounded-bucket representation."""
+        return self._rpc({"action": "aggregate"})
 
     def wait(self, timeout: float = 300.0, poll: float = 0.2) -> dict:
         import time

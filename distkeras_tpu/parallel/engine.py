@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 
 from distkeras_tpu import telemetry
 from distkeras_tpu.algorithms.base import CommitCtx, UpdateRule
+from distkeras_tpu.telemetry import dynamics as dynamics_mod
 from distkeras_tpu.models.adapter import ModelAdapter
 from distkeras_tpu.ops import get_loss, get_metric, get_optimizer
 from distkeras_tpu.parallel.mesh import (
@@ -246,6 +247,13 @@ class WindowedEngine:
                 f"commit_schedule has {len(self.commit_schedule)} entries for "
                 f"{self.num_workers} workers"
             )
+        # Training-dynamics stats (telemetry.dynamics).  Resolved ONCE at
+        # engine build so the trace-time branches in the window/step bodies
+        # are stable for the life of the cached epoch programs; with the
+        # flag off not a single extra op is traced — the jitted program is
+        # identical to a build without the feature (pinned in
+        # tests/test_dynamics.py).
+        self._dynamics = dynamics_mod.enabled()
         self._epoch_fns = {}
 
     # ------------------------------------------------------------------ init
@@ -456,8 +464,17 @@ class WindowedEngine:
             params, model_state
         )
         grads = self._sync_grads(grads)
+        if self._dynamics:
+            # per-step health leaves ride the scan ys; reduced to per-window
+            # scalars in the window body (no per-step collective)
+            dstep = {
+                "grad_sq": dynamics_mod.tree_sq_norm(grads),
+                "grad_nonfinite": dynamics_mod.tree_nonfinite_count(grads),
+            }
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if self._dynamics:
+            return (params, opt_state, model_state, rng), (loss, mets, dstep)
         return (params, opt_state, model_state, rng), (loss, mets)
 
     def _sync_grads(self, grads):
@@ -546,20 +563,50 @@ class WindowedEngine:
 
         def per_worker_window(center_params, center_rule, local, wdata):
             local_params, opt_state, model_state, rule_local, rng = local
-            (local_params, opt_state, model_state, rng), (losses, mets) = lax.scan(
-                self._local_step, (local_params, opt_state, model_state, rng),
-                wdata, unroll=self.unroll,
-            )
+            if self._dynamics:
+                (local_params, opt_state, model_state, rng), (losses, mets, dstep) = lax.scan(
+                    self._local_step, (local_params, opt_state, model_state, rng),
+                    wdata, unroll=self.unroll,
+                )
+            else:
+                (local_params, opt_state, model_state, rng), (losses, mets) = lax.scan(
+                    self._local_step, (local_params, opt_state, model_state, rng),
+                    wdata, unroll=self.unroll,
+                )
+            dyn = None
+            if self._dynamics:
+                # pre-commit snapshot: worker<->center drift and the rule's
+                # own staleness clocks, measured before the commit rewrites
+                # them.  All worker-local scalars — the end-of-epoch psum
+                # reduces them with the loss (no extra collective here).
+                full_center = self._fsdp_gather(center_params)
+                dyn = {
+                    "grad_sq": jnp.sum(dstep["grad_sq"]),
+                    "nonfinite_grads": jnp.sum(dstep["grad_nonfinite"]),
+                    "nonfinite_params": dynamics_mod.tree_nonfinite_count(local_params),
+                    "divergence_sq": dynamics_mod.tree_sq_dist(local_params, full_center),
+                    "staleness": jnp.asarray(float(window), jnp.float32),
+                    "update_sq": jnp.zeros((), jnp.float32),
+                }
+                dyn.update(rule.dynamics(
+                    self._make_ctx(do_commit, float(window)),
+                    local_params, full_center, rule_local, center_rule,
+                ))
             if do_commit:
                 # seq-axis fsdp: the commit is the one place the full center
                 # is needed — gather the shards at use, run the rule's math
                 # unchanged (so trajectories match the replicated layout
                 # exactly), keep only this row's block after
-                center_params = self._fsdp_gather(center_params)
+                center_params = (full_center if self._dynamics
+                                 else self._fsdp_gather(center_params))
+                center_before = center_params
                 ctx = self._make_ctx(True, float(window))
                 res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
                 local_params, center_params = res.local_params, res.center_params
                 rule_local, center_rule = res.local_state, res.center_state
+                if self._dynamics:
+                    dyn["update_sq"] = dynamics_mod.tree_sq_dist(
+                        center_params, center_before)
                 center_params = self._fsdp_shard(center_params)
                 model_state = self._sync_model_state(ctx, model_state)
             # Window stats stay worker-local here; one psum at the end of the
@@ -568,9 +615,34 @@ class WindowedEngine:
             loss_mean = jnp.mean(losses)
             mets_mean = jnp.mean(mets, axis=0)
             local = (local_params, opt_state, model_state, rule_local, rng)
+            if self._dynamics:
+                return center_params, center_rule, local, loss_mean, mets_mean, dyn
             return center_params, center_rule, local, loss_mean, mets_mean
 
         return per_worker_window
+
+    def _dyn_reduce(self, dyn, psum_axis=None):
+        """Reduce stacked dynamics leaves ``[T, v]`` (T windows or steps,
+        v workers in this trace) to the epoch-stats layout: *global* series
+        — grad norm, non-finite counts, center update norm, each ``[T]`` —
+        and *per-worker* series (divergence, staleness, rule extras), each
+        ``[T, v]``.  ``psum_axis`` totals the global leaves across mesh
+        devices (the windowed engine calls inside shard_map); the GSPMD
+        engine's vmap already spans every worker and passes None."""
+        total = (lambda a: jnp.sum(a, axis=1)) if psum_axis is None else (
+            lambda a: lax.psum(jnp.sum(a, axis=1), psum_axis))
+        dyn = dict(dyn)
+        dyn_global = {
+            "grad_norm": jnp.sqrt(total(dyn.pop("grad_sq"))),
+            # the committed center is identical across workers (psum'd):
+            # any column of the stacked leaf is the global value
+            "update_norm": jnp.sqrt(dyn.pop("update_sq")[:, 0]),
+            "nonfinite_grads": total(dyn.pop("nonfinite_grads")),
+            "nonfinite_params": total(dyn.pop("nonfinite_params")),
+        }
+        dyn_worker = dict(dyn)
+        dyn_worker["divergence"] = jnp.sqrt(dyn_worker.pop("divergence_sq"))
+        return dyn_global, dyn_worker
 
     # ------------------------------------------------------- epoch (windowed)
     def _build_epoch_core(self, n_windows: int, window: int, do_commit: bool, xs_ndim: int = 5):
@@ -581,7 +653,7 @@ class WindowedEngine:
         vmapped = jax.vmap(
             self._window_fn(do_commit, window),
             in_axes=(None, None, 0, 0),
-            out_axes=(0, 0, 0, 0, 0),
+            out_axes=(0, 0, 0, 0, 0, 0) if self._dynamics else (0, 0, 0, 0, 0),
             axis_name=VWORKER_AXIS,
         )
 
@@ -592,18 +664,24 @@ class WindowedEngine:
 
             def window_body(carry, wdata):
                 center_params, center_rule, local = carry
-                centers_p, centers_r, local, loss, mets = vmapped(
-                    center_params, center_rule, local, wdata
-                )
+                if self._dynamics:
+                    centers_p, centers_r, local, loss, mets, dyn = vmapped(
+                        center_params, center_rule, local, wdata
+                    )
+                else:
+                    centers_p, centers_r, local, loss, mets = vmapped(
+                        center_params, center_rule, local, wdata
+                    )
+                    dyn = ()
                 # psum over both axes makes every virtual worker's center
                 # identical; collapse the vmap dim.
                 center_params = jax.tree.map(lambda x: x[0], centers_p)
                 center_rule = jax.tree.map(lambda x: x[0], centers_r)
-                return (center_params, center_rule, local), (loss, mets)
+                return (center_params, center_rule, local), (loss, mets, dyn)
 
             # full unroll propagates to the window loop too (unroll=True is
             # the XLA:CPU compile-time escape hatch; ints stay step-only)
-            (center_params, center_rule, local), (losses, mets) = lax.scan(
+            (center_params, center_rule, local), (losses, mets, dyn) = lax.scan(
                 window_body, (center_params, center_rule, local), (xs, ys),
                 unroll=self.unroll is True,
             )
@@ -612,16 +690,24 @@ class WindowedEngine:
             losses = lax.psum(jnp.sum(losses, axis=1), self.axis) / self.num_workers
             mets = lax.psum(jnp.sum(mets, axis=1), self.axis) / self.num_workers
             losses, mets = self._reduce_seq_stats(losses, mets)
+            if self._dynamics:
+                dyn_global, dyn_worker = self._dyn_reduce(dyn, self.axis)
+                return (center_params, center_rule, local, losses, mets,
+                        dyn_global, dyn_worker)
             return center_params, center_rule, local, losses, mets
 
         xs_spec, ys_spec = self._data_specs(xs_ndim)
         center_spec, center_rule_spec = self._center_in_specs()
         local_spec = self._local_in_spec()
+        # dynamics outputs: globals replicated (post-psum), per-worker series
+        # concatenate over the worker axis — [n_windows, num_workers] global
+        dyn_out_specs = (P(), P(None, self.axis)) if self._dynamics else ()
         mapped = shard_map(
             worker_fn,
             mesh=self.mesh,
             in_specs=(center_spec, center_rule_spec, local_spec, xs_spec, ys_spec),
-            out_specs=(center_spec, center_rule_spec, local_spec, P(), P()),
+            out_specs=(center_spec, center_rule_spec, local_spec, P(), P())
+            + dyn_out_specs,
             check_vma=False,
             **({"axis_names": self._manual_axes} if self._manual_axes else {}),
         )
@@ -629,9 +715,15 @@ class WindowedEngine:
         def epoch_fn(state: TrainState, xs, ys):
             local = (state.local_params, state.opt_state, state.model_state,
                      state.rule_local, state.rng)
-            center_params, center_rule, local, losses, mets = mapped(
-                state.center_params, state.center_rule, local, xs, ys
-            )
+            if self._dynamics:
+                (center_params, center_rule, local, losses, mets,
+                 dyn_global, dyn_worker) = mapped(
+                    state.center_params, state.center_rule, local, xs, ys
+                )
+            else:
+                center_params, center_rule, local, losses, mets = mapped(
+                    state.center_params, state.center_rule, local, xs, ys
+                )
             local_params, opt_state, model_state, rule_local, rng = local
             new_state = TrainState(
                 center_params=center_params,
@@ -643,7 +735,10 @@ class WindowedEngine:
                 rng=rng,
                 epoch=state.epoch + 1,
             )
-            return new_state, {"loss": losses, "metrics": mets}
+            stats = {"loss": losses, "metrics": mets}
+            if self._dynamics:
+                stats["dynamics"] = {**dyn_global, **dyn_worker}
+            return new_state, stats
 
         return epoch_fn
 
@@ -724,9 +819,14 @@ class WindowedEngine:
 
         def per_worker_step(center_params, center_rule, local, since, batch, t, my_window):
             local_params, opt_state, model_state, rule_local, rng = local
-            (local_params, opt_state, model_state, rng), (loss, _) = self._local_step(
-                (local_params, opt_state, model_state, rng), batch
-            )
+            if self._dynamics:
+                (local_params, opt_state, model_state, rng), (loss, _, dstep) = self._local_step(
+                    (local_params, opt_state, model_state, rng), batch
+                )
+            else:
+                (local_params, opt_state, model_state, rng), (loss, _) = self._local_step(
+                    (local_params, opt_state, model_state, rng), batch
+                )
             since = since + 1
             mask = (t + 1) % my_window == 0
             ctx = self._make_ctx(mask, 1.0)
@@ -734,13 +834,33 @@ class WindowedEngine:
             # seq-axis fsdp: gather-at-use around the masked commit (a
             # masked-off step updates nothing, so gather->slice is identity)
             center_params = self._fsdp_gather(center_params)
+            dyn = None
+            if self._dynamics:
+                # effective staleness is the live counter itself: steps
+                # since this worker's last (masked) commit
+                dyn = {
+                    "grad_sq": dstep["grad_sq"],
+                    "nonfinite_grads": dstep["grad_nonfinite"],
+                    "nonfinite_params": dynamics_mod.tree_nonfinite_count(local_params),
+                    "divergence_sq": dynamics_mod.tree_sq_dist(local_params, center_params),
+                    "staleness": since.astype(jnp.float32),
+                    "update_sq": jnp.zeros((), jnp.float32),
+                }
+                dyn.update(rule.dynamics(
+                    ctx, local_params, center_params, rule_local, center_rule))
+            center_before = center_params
             res = rule.commit(ctx, local_params, center_params, rule_local, center_rule)
             local_params, center_params = res.local_params, res.center_params
             rule_local, center_rule = res.local_state, res.center_state
+            if self._dynamics:
+                dyn["update_sq"] = dynamics_mod.tree_sq_dist(
+                    center_params, center_before)
             center_params = self._fsdp_shard(center_params)
             model_state = self._sync_model_state(ctx, model_state)
             since = jnp.where(mask, 0, since)
             local = (local_params, opt_state, model_state, rule_local, rng)
+            if self._dynamics:
+                return center_params, center_rule, local, since, loss, dyn
             return center_params, center_rule, local, since, loss
 
         return per_worker_step
@@ -752,7 +872,7 @@ class WindowedEngine:
         vmapped = jax.vmap(
             self._step_fn(),
             in_axes=(None, None, 0, 0, 0, None, 0),
-            out_axes=(0, 0, 0, 0, 0),
+            out_axes=(0, 0, 0, 0, 0, 0) if self._dynamics else (0, 0, 0, 0, 0),
             axis_name=VWORKER_AXIS,
         )
 
@@ -765,15 +885,21 @@ class WindowedEngine:
             def step_body(carry, inp):
                 t, batch = inp
                 center_params, center_rule, local, since = carry
-                centers_p, centers_r, local, since, loss = vmapped(
-                    center_params, center_rule, local, since, batch, t, schedule
-                )
+                if self._dynamics:
+                    centers_p, centers_r, local, since, loss, dyn = vmapped(
+                        center_params, center_rule, local, since, batch, t, schedule
+                    )
+                else:
+                    centers_p, centers_r, local, since, loss = vmapped(
+                        center_params, center_rule, local, since, batch, t, schedule
+                    )
+                    dyn = ()
                 center_params = jax.tree.map(lambda x: x[0], centers_p)
                 center_rule = jax.tree.map(lambda x: x[0], centers_r)
-                return (center_params, center_rule, local, since), loss
+                return (center_params, center_rule, local, since), (loss, dyn)
 
             since0 = jnp.zeros((schedule.shape[0],), jnp.int32)
-            (center_params, center_rule, local, _), losses = lax.scan(
+            (center_params, center_rule, local, _), (losses, dyn) = lax.scan(
                 step_body, (center_params, center_rule, local, since0),
                 (jnp.arange(n_steps), (xs, ys)), unroll=self.unroll,
             )
@@ -781,17 +907,23 @@ class WindowedEngine:
             # windowed epoch fn for why this is not done per step).
             losses = lax.psum(jnp.sum(losses, axis=1), self.axis) / self.num_workers
             losses = self._reduce_seq_stats(losses)
+            if self._dynamics:
+                dyn_global, dyn_worker = self._dyn_reduce(dyn, self.axis)
+                return (center_params, center_rule, local, losses,
+                        dyn_global, dyn_worker)
             return center_params, center_rule, local, losses
 
         xs_spec, ys_spec = self._data_specs(xs_ndim)
         center_spec, center_rule_spec = self._center_in_specs()
         local_spec = self._local_in_spec()
+        dyn_out_specs = (P(), P(None, self.axis)) if self._dynamics else ()
         mapped = shard_map(
             worker_fn,
             mesh=self.mesh,
             in_specs=(center_spec, center_rule_spec, local_spec, xs_spec, ys_spec,
                       P(self.axis)),
-            out_specs=(center_spec, center_rule_spec, local_spec, P()),
+            out_specs=(center_spec, center_rule_spec, local_spec, P())
+            + dyn_out_specs,
             check_vma=False,
             **({"axis_names": self._manual_axes} if self._manual_axes else {}),
         )
@@ -801,9 +933,17 @@ class WindowedEngine:
         def epoch_fn(state: TrainState, xs, ys):
             local = (state.local_params, state.opt_state, state.model_state,
                      state.rule_local, state.rng)
-            center_params, center_rule, local, losses = mapped(
-                state.center_params, state.center_rule, local, xs, ys, schedule_arr
-            )
+            if self._dynamics:
+                (center_params, center_rule, local, losses,
+                 dyn_global, dyn_worker) = mapped(
+                    state.center_params, state.center_rule, local, xs, ys,
+                    schedule_arr
+                )
+            else:
+                center_params, center_rule, local, losses = mapped(
+                    state.center_params, state.center_rule, local, xs, ys,
+                    schedule_arr
+                )
             local_params, opt_state, model_state, rule_local, rng = local
             new_state = TrainState(
                 center_params=center_params,
@@ -815,7 +955,10 @@ class WindowedEngine:
                 rng=rng,
                 epoch=state.epoch + 1,
             )
-            return new_state, {"loss": losses, "metrics": jnp.zeros((0,))}
+            stats = {"loss": losses, "metrics": jnp.zeros((0,))}
+            if self._dynamics:
+                stats["dynamics"] = {**dyn_global, **dyn_worker}
+            return new_state, stats
 
         return jax.jit(epoch_fn, donate_argnums=(0,))
 
@@ -965,7 +1108,7 @@ class WindowedEngine:
 
         it = iter(window_iter)
         buf = deque()
-        losses, mets = [], []
+        stats_list = []
         n_windows = 0
         depth = max(1, prefetch)
         while True:
@@ -982,8 +1125,7 @@ class WindowedEngine:
                 state, stats = self.run_epoch(
                     state, xs, ys, sync_telemetry=False)
             n_windows += 1
-            losses.append(stats["loss"])
-            mets.append(stats["metrics"])
+            stats_list.append(stats)
             # Backpressure: dispatch is async, so without a sync the host
             # would device_put the whole epoch ahead of the device and defeat
             # the memory bound.  Waiting on the loss of the window dispatched
@@ -992,7 +1134,7 @@ class WindowedEngine:
             if n_windows > depth:
                 with telemetry.trace.span("window_wait", phase="step",
                                           window=n_windows - 1 - depth):
-                    jax.block_until_ready(losses[n_windows - 1 - depth])
+                    jax.block_until_ready(stats_list[n_windows - 1 - depth]["loss"])
             # Refill AFTER dispatching (first window included): the very
             # first window's compute then hides the rest of the initial
             # prefill's source latency — measured, not assumed, in
@@ -1002,9 +1144,11 @@ class WindowedEngine:
                 if block is None:
                     break
                 buf.append(put(block))
-        if not losses:
+        if not stats_list:
             raise ValueError("empty window iterator")
-        stats = {"loss": jnp.concatenate(losses), "metrics": jnp.concatenate(mets)}
+        # generic over the stats pytree (loss/metrics, plus the dynamics
+        # subtree when enabled): concatenate every leaf along the window axis
+        stats = jax.tree.map(lambda *leaves: jnp.concatenate(leaves), *stats_list)
         # each window ran as its own "epoch" program (epoch += n_windows);
         # restore whole-epoch semantics (+1).  The input state was donated by
         # the first window's call, so arithmetic uses the live output state.

@@ -270,7 +270,7 @@ class GSPMDEngine(WindowedEngine):
         vmapped = jax.vmap(
             self._window_fn(do_commit, window),
             in_axes=(None, None, 0, 0),
-            out_axes=(0, 0, 0, 0, 0),
+            out_axes=(0, 0, 0, 0, 0, 0) if self._dynamics else (0, 0, 0, 0, 0),
             axis_name=VWORKER_AXIS,
         )
 
@@ -282,9 +282,15 @@ class GSPMDEngine(WindowedEngine):
 
             def window_body(carry, wdata):
                 center_params, center_rule, local = carry
-                centers_p, centers_r, local, loss, mets = vmapped(
-                    center_params, center_rule, local, wdata
-                )
+                if self._dynamics:
+                    centers_p, centers_r, local, loss, mets, dyn = vmapped(
+                        center_params, center_rule, local, wdata
+                    )
+                else:
+                    centers_p, centers_r, local, loss, mets = vmapped(
+                        center_params, center_rule, local, wdata
+                    )
+                    dyn = ()
                 # psum over the vmap axis makes every worker's center copy
                 # identical; collapse the stacked dim and re-pin the TP
                 # sharding so the scan carry stays partitioned.  The whole
@@ -297,10 +303,10 @@ class GSPMDEngine(WindowedEngine):
                 )
                 center_rule = jax.tree.map(lambda x: x[0], centers_r)
                 local = self._constrain_worker(local)
-                return (center_params, center_rule, local), (loss, mets)
+                return (center_params, center_rule, local), (loss, mets, dyn)
 
             # see the shard_map engine: unroll=True propagates to this loop
-            (center_params, center_rule, local), (losses, mets) = lax.scan(
+            (center_params, center_rule, local), (losses, mets, dyn) = lax.scan(
                 window_body,
                 (state.center_params, state.center_rule, local),
                 (xs, ys), unroll=self.unroll is True,
@@ -312,6 +318,11 @@ class GSPMDEngine(WindowedEngine):
                 "loss": jnp.mean(losses, axis=1),
                 "metrics": jnp.mean(mets, axis=1),
             }
+            if self._dynamics:
+                # the vmap already spans every logical worker: plain
+                # reductions, no psum (the partitioner all-reduces them)
+                dyn_global, dyn_worker = self._dyn_reduce(dyn)
+                stats["dynamics"] = {**dyn_global, **dyn_worker}
             new_state = TrainState(
                 center_params=center_params,
                 center_rule=center_rule,
@@ -332,7 +343,7 @@ class GSPMDEngine(WindowedEngine):
         vmapped = jax.vmap(
             self._step_fn(),
             in_axes=(None, None, 0, 0, 0, None, 0),
-            out_axes=(0, 0, 0, 0, 0),
+            out_axes=(0, 0, 0, 0, 0, 0) if self._dynamics else (0, 0, 0, 0, 0),
             axis_name=VWORKER_AXIS,
         )
         schedule_arr = jnp.asarray(self.commit_schedule, jnp.int32)
@@ -346,18 +357,26 @@ class GSPMDEngine(WindowedEngine):
             def step_body(carry, inp):
                 t, batch = inp
                 center_params, center_rule, local, since = carry
-                centers_p, centers_r, local, since, loss = vmapped(
-                    center_params, center_rule, local, since, batch, t, schedule_arr
-                )
+                if self._dynamics:
+                    centers_p, centers_r, local, since, loss, dyn = vmapped(
+                        center_params, center_rule, local, since, batch, t,
+                        schedule_arr
+                    )
+                else:
+                    centers_p, centers_r, local, since, loss = vmapped(
+                        center_params, center_rule, local, since, batch, t,
+                        schedule_arr
+                    )
+                    dyn = ()
                 center_params = self._constrain_center(
                     jax.tree.map(lambda x: x[0], centers_p)
                 )
                 center_rule = jax.tree.map(lambda x: x[0], centers_r)
                 local = self._constrain_worker(local)  # see windowed epoch fn
-                return (center_params, center_rule, local, since), loss
+                return (center_params, center_rule, local, since), (loss, dyn)
 
             since0 = jnp.zeros((self.num_workers,), jnp.int32)
-            (center_params, center_rule, local, _), losses = lax.scan(
+            (center_params, center_rule, local, _), (losses, dyn) = lax.scan(
                 step_body,
                 (state.center_params, state.center_rule, local, since0),
                 (jnp.arange(n_steps), (xs, ys)), unroll=self.unroll,
@@ -373,8 +392,12 @@ class GSPMDEngine(WindowedEngine):
                 rng=rng,
                 epoch=state.epoch + 1,
             )
-            return new_state, {"loss": jnp.mean(losses, axis=1),
-                               "metrics": jnp.zeros((0,))}
+            stats = {"loss": jnp.mean(losses, axis=1),
+                     "metrics": jnp.zeros((0,))}
+            if self._dynamics:
+                dyn_global, dyn_worker = self._dyn_reduce(dyn)
+                stats["dynamics"] = {**dyn_global, **dyn_worker}
+            return new_state, stats
 
         return jax.jit(epoch_fn, donate_argnums=(0,))
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import os
 
-from distkeras_tpu.telemetry import runtime
+from distkeras_tpu.telemetry import dynamics, runtime
 from distkeras_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -42,6 +42,7 @@ __all__ = [
     "Span",
     "Tracer",
     "configure",
+    "dynamics",
     "enabled",
     "flush",
     "install_jax_hooks",

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import math
 import threading
 
 from distkeras_tpu.telemetry import runtime
@@ -34,7 +35,9 @@ __all__ = [
     "PHASES",
     "Registry",
     "install_jax_hooks",
+    "merge_snapshots",
     "metrics",
+    "prometheus_from_snapshot",
 ]
 
 # Exponential seconds ladder: 100µs .. 60s covers everything from a single
@@ -248,6 +251,111 @@ class Registry:
                     and name.endswith("_seconds")):
                 out[name[len("phase_"):-len("_seconds")]] = inst.sum
         return out
+
+
+# -------------------------------------------------- fleet-level aggregation
+
+
+def _le_key(le):
+    return math.inf if le == "+Inf" else float(le)
+
+
+def _le_label(le):
+    return "+Inf" if _le_key(le) == math.inf else _fmt_float(float(le))
+
+
+def _merge_histograms(payloads) -> dict:
+    """Merge histogram snapshots on their cumulative bounded-bucket form.
+
+    The merged ladder is the union of the inputs' ``le`` labels.  A snapshot
+    missing a label contributes its cumulative count at its largest bound
+    <= that label (carry-forward) — exact for cumulative distributions, so
+    merging loses nothing as long as jobs share a ladder, and degrades
+    conservatively (counts attributed to the next coarser bound) when they
+    don't.  Sums and counts add."""
+    per_snap = []
+    labels = set()
+    for p in payloads:
+        bounds = sorted(((_le_key(le), n) for le, n in p["buckets"].items()))
+        per_snap.append(bounds)
+        labels.update(_le_key(le) for le in p["buckets"])
+    merged = {}
+    for le_val in sorted(labels):
+        total = 0
+        for bounds in per_snap:
+            idx = bisect.bisect_right([b for b, _ in bounds], le_val) - 1
+            total += bounds[idx][1] if idx >= 0 else 0
+        merged[_le_label(le_val)] = total
+    return {
+        "type": "histogram",
+        "sum": sum(p["sum"] for p in payloads),
+        "count": sum(p["count"] for p in payloads),
+        "buckets": merged,
+    }
+
+
+def merge_snapshots(snapshots) -> dict:
+    """Merge per-job :meth:`Registry.snapshot` dicts into one fleet view.
+
+    Counters sum (fleet totals); gauges keep the **max** as their value —
+    for health stats the worst worker is the signal — and carry the fleet
+    ``mean`` alongside; histograms merge exactly via
+    :func:`_merge_histograms`.  Raises on a name registered with different
+    types across jobs."""
+    merged: dict = {}
+    grouped: dict = {}
+    for snap in snapshots:
+        for name, payload in snap.items():
+            grouped.setdefault(name, []).append(payload)
+    for name, payloads in sorted(grouped.items()):
+        kinds = {p["type"] for p in payloads}
+        if len(kinds) > 1:
+            raise ValueError(
+                f"metric {name!r} has conflicting types across jobs: "
+                f"{sorted(kinds)}"
+            )
+        kind = kinds.pop()
+        if kind == "counter":
+            merged[name] = {
+                "type": "counter",
+                "value": sum(p["value"] for p in payloads),
+            }
+        elif kind == "gauge":
+            values = [p["value"] for p in payloads]
+            merged[name] = {
+                "type": "gauge",
+                "value": max(values),
+                "mean": sum(values) / len(values),
+            }
+        else:
+            merged[name] = _merge_histograms(payloads)
+    return merged
+
+
+def prometheus_from_snapshot(snapshot, help_map=None) -> str:
+    """Prometheus text exposition for a snapshot dict (per-job or merged).
+
+    Merged gauges (carrying a ``mean``) export two labelled samples,
+    ``{agg="max"}`` and ``{agg="mean"}``; everything else renders exactly
+    like :meth:`Registry.to_prometheus`."""
+    lines = []
+    for name, payload in sorted(snapshot.items()):
+        kind = payload["type"]
+        help_text = (help_map or {}).get(name)
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        if kind == "histogram":
+            for le, n in payload["buckets"].items():
+                lines.append(f'{name}_bucket{{le="{le}"}} {n}')
+            lines.append(f"{name}_sum {_fmt_float(payload['sum'])}")
+            lines.append(f"{name}_count {payload['count']}")
+        elif kind == "gauge" and "mean" in payload:
+            lines.append(f'{name}{{agg="max"}} {_fmt_float(payload["value"])}')
+            lines.append(f'{name}{{agg="mean"}} {_fmt_float(payload["mean"])}')
+        else:
+            lines.append(f"{name} {_fmt_float(payload['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 # Process-global registry: one scrape surface per process, like the tracer.

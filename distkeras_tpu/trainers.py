@@ -265,6 +265,27 @@ class Trainer:
             raw["center_params"], raw["center_rule"], model_state, epoch,
         )
 
+    def _watchdog_rollback(self, engine, ckpt, state, watchdog):
+        """Restore the last checkpoint after a watchdog trip (policy
+        ``rollback``): the diverged state is discarded and training
+        continues from the restored center/workers — the same
+        :meth:`_restore_state` path a crash-resume takes."""
+        reason = watchdog.pending_rollback
+        step = ckpt.latest() if ckpt is not None else None
+        if step is None:
+            raise telemetry.dynamics.TrainingDiverged(
+                f"{reason} — rollback requested but no checkpoint has been "
+                "saved yet"
+            )
+        state = self._restore_state(ckpt, engine, state, elastic=False, step=step)
+        watchdog.rolled_back()
+        if telemetry.enabled():
+            telemetry.metrics.counter(
+                "dynamics_rollbacks_total",
+                help="watchdog-triggered checkpoint restores",
+            ).inc()
+        return state
+
     def _fit(
         self,
         dataframe: DataFrame,
@@ -396,6 +417,26 @@ class Trainer:
                     "num_workers instead."
                 )
 
+        # Divergence watchdog: armed only when the engine traces dynamics
+        # stats (DISTKERAS_DYNAMICS=1 and not the pipeline engine).  All its
+        # checks run on host numpy AFTER the epoch's stats land — never
+        # inside the step loop (dklint DK107).
+        watchdog = None
+        if getattr(engine, "_dynamics", False):
+            watchdog = telemetry.dynamics.DivergenceWatchdog.from_config()
+        if watchdog is not None and watchdog.policy == "rollback":
+            if ckpt is None:
+                raise ValueError(
+                    "watchdog policy 'rollback' needs checkpoint_dir set so "
+                    "there is a checkpoint to restore"
+                )
+            if self.dispatch_epochs > 1:
+                raise ValueError(
+                    "watchdog policy 'rollback' needs the per-epoch loop; "
+                    "dispatch_epochs>1 runs whole chunks per dispatch with no "
+                    "epoch boundary to restore at"
+                )
+
         # The elastic path builds its state straight from the partial
         # restore — a fresh init_state would be thrown away (and costs a
         # full-state materialisation).  The pipeline engine still needs
@@ -431,6 +472,14 @@ class Trainer:
 
         def _materialise(stats, epoch_idx):
             stats = jax.tree.map(np.asarray, stats)
+            dyn = stats.get("dynamics")
+            summary = None
+            if dyn is not None:
+                # gauges first so the scalar-logger bridge below picks up
+                # this epoch's values, then the full series into the
+                # metrics JSONL
+                summary = telemetry.dynamics.summarize(dyn, loss=stats["loss"])
+                telemetry.dynamics.record(epoch_idx, dyn, summary)
             if scalar_log is not None:
                 scalars = {"loss": float(np.mean(stats["loss"]))}
                 mets = np.asarray(stats["metrics"])
@@ -442,6 +491,10 @@ class Trainer:
                 scalar_log.log(epoch_idx, **scalars)
                 if telemetry.enabled():
                     telemetry.metrics.to_scalar_logger(scalar_log, epoch_idx)
+            if summary is not None and watchdog is not None:
+                # after logging so a halting epoch still reaches the logs;
+                # raises TrainingDiverged under the halt policy
+                watchdog.observe(epoch_idx, summary)
             return stats
 
         epoch_stats: List[dict] = []
@@ -529,12 +582,24 @@ class Trainer:
                     # this epoch's device compute.  Materialise the previous
                     # epoch's stats now (its compute is long done) so retention
                     # stays O(1).
-                    if epoch_stats:
+                    if epoch_stats and not isinstance(
+                            jax.tree.leaves(epoch_stats[-1])[0], np.ndarray):
                         epoch_stats[-1] = _materialise(epoch_stats[-1], epoch - 1)
                     epoch_stats.append(stats)
+                    if watchdog is not None:
+                        # an armed watchdog trades the one-epoch async
+                        # overlap for prompt detection: materialise (and
+                        # observe) the epoch that just ran instead of
+                        # deferring it to the next iteration
+                        epoch_stats[-1] = _materialise(stats, epoch)
+                        if watchdog.pending_rollback:
+                            state = self._watchdog_rollback(
+                                engine, ckpt, state, watchdog)
+                            continue  # don't checkpoint the diverged state
                     if ckpt is not None:
                         ckpt.maybe_save(state, epoch)
-            if epoch_stats:
+            if epoch_stats and not isinstance(
+                    jax.tree.leaves(epoch_stats[-1])[0], np.ndarray):
                 epoch_stats[-1] = _materialise(epoch_stats[-1], self.num_epoch - 1)
             if ckpt is not None:
                 ckpt.wait()  # flush in-flight async saves before declaring done

@@ -1,0 +1,53 @@
+"""DK107 fixture: finiteness checks pulled to host in step loops.  Parsed, never run."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def per_step_host_checks(batches, params):
+    for batch in batches:
+        loss, grads = step(params, batch)
+        if bool(jnp.isnan(loss)):  # DK107: bool() cast in the loop body
+            break
+        bad = jnp.isinf(loss).item()  # DK107: .item() pull per step
+        mask = np.asarray(jnp.isnan(grads))  # DK107: np.asarray hostifies
+        fetched = jax.device_get(jnp.isfinite(grads))  # DK107: device_get
+    return params, bad, mask, fetched
+
+
+def while_on_device_check(params, x):
+    while not jnp.isnan(x).any():  # DK107: while-test through .any()
+        x = refine(params, x)
+    return x
+
+
+def branch_through_reduction(chunks, x):
+    while chunks:
+        x = chunks.pop()
+        if jnp.any(jnp.isfinite(x)):  # DK107: if-test through jnp.any
+            keep(x)
+
+
+def assert_every_step(batches, params):
+    for batch in batches:
+        out = step(params, batch)
+        assert not jnp.isnan(out).any()  # DK107: assert syncs per step
+
+
+def suppressed(batches, loss):
+    for _ in batches:
+        if bool(jnp.isnan(loss)):  # dklint: disable=DK107
+            break
+
+
+def in_graph_ok(x, grads):
+    for _ in range(3):
+        x = jnp.where(jnp.isnan(x), 0.0, x)  # in-graph masking: clean
+        count = jnp.sum(~jnp.isfinite(grads))  # in-graph counter: clean
+    return x, count
+
+
+def one_off_ok(loss):
+    # a single post-training host check is legitimate off the hot path
+    return bool(jnp.isnan(loss))

@@ -45,6 +45,30 @@ def test_run_config_schema(monkeypatch):
     json.dumps(out)  # driver requires one JSON line
 
 
+def test_run_config_records_dynamics_gauges(monkeypatch):
+    """DISTKERAS_DYNAMICS=1 bench run: the health summary rides in the
+    emitted record next to "phases" and lands in the metrics registry."""
+    from distkeras_tpu import telemetry
+
+    telemetry.dynamics.configure(enabled=True, watchdog="off")
+    try:
+        engine, _, window, shape, int_data, classes = bench._engine_for(
+            "mnist_mlp_single")
+        monkeypatch.setattr(
+            bench, "_engine_for",
+            lambda config, num_workers=None:
+            (engine, 8, window, shape, int_data, classes))
+        out = bench.run_config("mnist_mlp_single", n_windows=1, reps=1, k=1)
+    finally:
+        telemetry.dynamics.configure()
+    dyn = out["dynamics"]
+    assert dyn["grad_norm"] > 0
+    assert "update_norm" in dyn and "divergence_max" in dyn
+    assert dyn["nonfinite_grads_max"] == 0
+    assert telemetry.metrics.snapshot()["dynamics_grad_norm"]["value"] > 0
+    json.dumps(out)  # still one JSON line for the driver
+
+
 def test_vs_baseline_null_when_unpinned(monkeypatch, tmp_path):
     engine, _, window, shape, int_data, classes = bench._engine_for("mnist_mlp_single")
     monkeypatch.setattr(

@@ -1,0 +1,433 @@
+"""Training-dynamics observability tests: the in-graph health stats the
+engines trace under DISTKERAS_DYNAMICS=1 (grad/update norms, worker<->center
+divergence, non-finite counts, effective staleness), the zero-cost pin for
+the disabled path (byte-identical lowering), the DynSGD staleness gauge
+against host-side rule bookkeeping, and the divergence watchdog's
+warn/halt/rollback policies end to end through the trainers."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import distkeras_tpu as dk
+from distkeras_tpu import telemetry
+from distkeras_tpu.algorithms import Adag, Downpour, DynSGD
+from distkeras_tpu.data import epoch_arrays
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.models import MLP, FlaxModel
+from distkeras_tpu.parallel.engine import WindowedEngine
+from distkeras_tpu.parallel.gspmd import GSPMDEngine
+from distkeras_tpu.telemetry.dynamics import (
+    DivergenceWatchdog,
+    DynamicsConfig,
+    TrainingDiverged,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_dynamics():
+    """Dynamics config is process-cached (engines read it at build); leave
+    every test with the env-driven defaults restored."""
+    yield
+    telemetry.dynamics.configure()
+    telemetry.configure(None)
+    telemetry.trace.reset()
+    telemetry.metrics.reset()
+
+
+def _toy(n=256, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d,))
+    y = (x @ w > 0).astype(np.int32)
+    onehot = np.zeros((n, 2), np.float32)
+    onehot[np.arange(n), y] = 1.0
+    return x, onehot
+
+
+def _mlp():
+    return FlaxModel(MLP(features=(16,), num_classes=2))
+
+
+def _engine(rule=None, workers=2, **kw):
+    return WindowedEngine(
+        _mlp(),
+        loss="categorical_crossentropy",
+        worker_optimizer=("sgd", {"learning_rate": 0.1}),
+        rule=rule or Downpour(communication_window=2),
+        num_workers=workers,
+        **kw,
+    )
+
+
+def _run_one_epoch(eng, x, onehot, batch=16, window=2, stepwise=False):
+    state = eng.init_state(jax.random.PRNGKey(0), x[:batch])
+    xs, ys = epoch_arrays(x, onehot, eng.num_workers, batch, window,
+                          stepwise=stepwise)
+    xs, ys = eng.shard_batches(xs, ys)
+    state, stats = eng.run_epoch(state, xs, ys)
+    return state, jax.tree.map(np.asarray, stats)
+
+
+# ------------------------------------------------------------------- config
+
+def test_config_defaults_and_env(monkeypatch):
+    assert DynamicsConfig().enabled is False  # off unless asked for
+    monkeypatch.delenv("DISTKERAS_DYNAMICS", raising=False)
+    assert DynamicsConfig.from_env().enabled is False
+    monkeypatch.setenv("DISTKERAS_DYNAMICS", "1")
+    monkeypatch.setenv("DISTKERAS_DYNAMICS_WATCHDOG", "halt")
+    monkeypatch.setenv("DISTKERAS_DYNAMICS_FACTOR", "5.5")
+    cfg = DynamicsConfig.from_env()
+    assert (cfg.enabled, cfg.watchdog, cfg.divergence_factor) == (True, "halt", 5.5)
+    with pytest.raises(ValueError):
+        DynamicsConfig(watchdog="explode")
+    with pytest.raises(ValueError):
+        DynamicsConfig(divergence_factor=0.5)
+
+
+def test_configure_overrides_and_enabled():
+    telemetry.dynamics.configure(enabled=True, watchdog="off")
+    assert telemetry.dynamics.enabled() is True
+    assert DivergenceWatchdog.from_config() is None  # off policy: unarmed
+    telemetry.dynamics.configure(enabled=False)
+    assert telemetry.dynamics.enabled() is False
+
+
+# ------------------------------------------------- disabled path stays free
+
+def _lowered_epoch_text(eng, x, onehot, batch=16, window=2):
+    state = eng.init_state(jax.random.PRNGKey(0), x[:batch])
+    xs, ys = epoch_arrays(x, onehot, eng.num_workers, batch, window)
+    xs, ys = eng.shard_batches(xs, ys)
+    fn = eng._make_epoch_fn(xs.shape[1], window, True, xs.ndim)
+    with eng.mesh:
+        return fn.lower(state, xs, ys).as_text()
+
+
+def test_disabled_path_lowering_is_byte_identical():
+    """The feature's trace-time branches must add ZERO ops when off: two
+    independently-built disabled engines lower to byte-identical programs,
+    and the enabled program is a strict superset (different text, with the
+    finiteness ops only it traces)."""
+    x, onehot = _toy()
+    telemetry.dynamics.configure(enabled=False)
+    off_a = _lowered_epoch_text(_engine(), x, onehot)
+    off_b = _lowered_epoch_text(_engine(), x, onehot)
+    assert off_a == off_b
+    assert "is_finite" not in off_a
+
+    telemetry.dynamics.configure(enabled=True, watchdog="off")
+    on = _lowered_epoch_text(_engine(), x, onehot)
+    assert on != off_a
+    assert "is_finite" in on
+    assert len(on) > len(off_a)
+
+
+def test_disabled_stats_have_no_dynamics_key():
+    x, onehot = _toy()
+    telemetry.dynamics.configure(enabled=False)
+    eng = _engine()
+    assert eng._dynamics is False
+    _, stats = _run_one_epoch(eng, x, onehot)
+    assert sorted(stats) == ["loss", "metrics"]
+
+
+def test_trajectory_unchanged_by_dynamics():
+    x, onehot = _toy()
+    telemetry.dynamics.configure(enabled=False)
+    _, base = _run_one_epoch(_engine(), x, onehot)
+    telemetry.dynamics.configure(enabled=True, watchdog="off")
+    _, instrumented = _run_one_epoch(_engine(), x, onehot)
+    np.testing.assert_allclose(instrumented["loss"], base["loss"], rtol=1e-6)
+
+
+# ------------------------------------------------------- the in-graph stats
+
+def test_windowed_engine_traces_dynamics_leaves():
+    x, onehot = _toy()
+    telemetry.dynamics.configure(enabled=True, watchdog="off")
+    eng = _engine(workers=2)
+    _, stats = _run_one_epoch(eng, x, onehot, window=2)
+    dyn = stats["dynamics"]
+    n_windows = len(stats["loss"])
+    # global per-window leaves
+    for k in ("grad_norm", "update_norm", "nonfinite_grads", "nonfinite_params"):
+        assert dyn[k].shape == (n_windows,), k
+    # per-worker leaves
+    for k in ("divergence", "staleness"):
+        assert dyn[k].shape == (n_windows, 2), k
+    assert np.all(dyn["grad_norm"] > 0)
+    assert np.all(dyn["update_norm"] > 0)  # every window commits here
+    assert np.all(dyn["nonfinite_grads"] == 0)
+    assert np.all(dyn["nonfinite_params"] == 0)
+    assert np.all(dyn["staleness"] == 2.0)  # uniform window of 2 steps
+
+
+def test_gspmd_engine_traces_dynamics_with_rule_extras():
+    x, onehot = _toy()
+    telemetry.dynamics.configure(enabled=True, watchdog="off")
+    eng = GSPMDEngine(
+        _mlp(),
+        loss="categorical_crossentropy",
+        worker_optimizer=("sgd", {"learning_rate": 0.1}),
+        rule=Adag(communication_window=2),
+        num_workers=4,
+    )
+    _, stats = _run_one_epoch(eng, x, onehot, window=2)
+    dyn = stats["dynamics"]
+    n_windows = len(stats["loss"])
+    assert dyn["grad_norm"].shape == (n_windows,)
+    assert dyn["divergence"].shape == (n_windows, 4)
+    # Adag's dynamics() hook exposes its accumulation state pre-commit
+    assert np.all(dyn["rule_accum_norm"] > 0)
+    assert np.all(dyn["rule_accum_steps"] == 2.0)
+
+
+def _expected_dynsgd_staleness(schedule, n_steps):
+    """Host model of the PS race (same semantics as test_staleness): each
+    step every worker observes ``num_updates`` BEFORE the step's commits;
+    committers then bump the counter and adopt it as their clock."""
+    clocks = [0] * len(schedule)
+    num_updates = 0
+    rows = []
+    for t in range(n_steps):
+        rows.append([num_updates - c for c in clocks])
+        committers = [i for i, p in enumerate(schedule) if (t + 1) % p == 0]
+        num_updates += len(committers)
+        for i in committers:
+            clocks[i] = num_updates
+    return np.asarray(rows, np.float32)
+
+
+def test_dynsgd_staleness_gauge_matches_rule_bookkeeping():
+    """The acceptance pin for the DynSGD extras: the traced
+    ``rule_staleness`` series equals an independent host-side model of the
+    clocks, ``rule_scale`` is exactly 1/(staleness+1), and the summary
+    gauge is the series max."""
+    x, onehot = _toy(n=256)
+    schedule = np.array([1, 2, 1, 4])
+    workers, batch = 4, 16
+    telemetry.dynamics.configure(enabled=True, watchdog="off")
+    eng = _engine(rule=DynSGD(communication_window=2), workers=workers,
+                  commit_schedule=schedule)
+    _, stats = _run_one_epoch(eng, x, onehot, batch=batch, stepwise=True)
+    n_steps = 256 // (workers * batch)
+    dyn = stats["dynamics"]
+    expected = _expected_dynsgd_staleness(schedule, n_steps)
+    np.testing.assert_array_equal(dyn["rule_staleness"], expected)
+    np.testing.assert_allclose(dyn["rule_scale"], 1.0 / (expected + 1.0),
+                               rtol=1e-6)
+    summary = telemetry.dynamics.summarize(dyn, loss=stats["loss"])
+    assert summary["rule_staleness_max"] == expected.max()
+    assert summary["loss_nonfinite"] == 0.0
+    # and the gauge lands in the registry under the dynamics_ prefix
+    telemetry.configure(True)
+    telemetry.metrics.reset()
+    telemetry.dynamics.record_gauges(summary)
+    snap = telemetry.metrics.snapshot()
+    assert snap["dynamics_rule_staleness_max"]["value"] == expected.max()
+
+
+# ------------------------------------------------------------ trainer smoke
+
+def test_smoke_train_emits_dynamics_series(tmp_path, monkeypatch):
+    """The acceptance smoke: a 2-worker CPU run with the flag on writes the
+    grad-norm/update-norm/divergence/staleness series into the metrics
+    JSONL, one line per epoch, with zero non-finite events."""
+    monkeypatch.setenv("DISTKERAS_TELEMETRY_DIR", str(tmp_path))
+    telemetry.configure(True)
+    telemetry.trace.reset()
+    telemetry.metrics.reset()
+    telemetry.dynamics.configure(enabled=True, watchdog="off")
+
+    x, onehot = _toy()
+    t = dk.DOWNPOUR(_mlp(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=2, batch_size=16, num_epoch=2,
+                    communication_window=2, seed=7)
+    t.train(from_numpy(x, onehot))
+
+    files = [f for f in os.listdir(tmp_path) if f.startswith("metrics_")]
+    assert len(files) == 1
+    lines = [json.loads(l) for l in open(tmp_path / files[0])
+             if l.strip()]
+    series_lines = [l for l in lines if l.get("type") == "dynamics"]
+    assert [l["epoch"] for l in series_lines] == [0, 1]
+    series = series_lines[-1]["series"]
+    assert {"grad_norm", "update_norm", "divergence", "staleness",
+            "nonfinite_grads", "nonfinite_params"} <= set(series)
+    n_windows = series["grad_norm"]["shape"][0]
+    assert series["divergence"]["shape"] == [n_windows, 2]
+    assert all(v == 0 for v in series["nonfinite_grads"]["values"])
+    # summaries became gauges in the registry snapshot line flush() writes
+    assert series_lines[-1]["summary"]["grad_norm"] > 0
+    snap = telemetry.metrics.snapshot()
+    assert "dynamics_grad_norm" in snap
+    assert "dynamics_divergence_max" in snap
+
+
+# ---------------------------------------------------------------- watchdog
+
+def _summary(**kw):
+    base = {"nonfinite_grads_max": 0.0, "nonfinite_params_max": 0.0,
+            "loss_nonfinite": 0.0, "divergence_max": 1.0}
+    base.update(kw)
+    return base
+
+
+def test_watchdog_healthy_epochs_build_history():
+    wd = DivergenceWatchdog(policy="warn", min_history=3)
+    for e in range(4):
+        assert wd.observe(e, _summary(divergence_max=1.0 + 0.1 * e)) is None
+    assert wd.trips == 0
+
+
+def test_watchdog_warn_on_nonfinite_and_divergence():
+    wd = DivergenceWatchdog(policy="warn", divergence_factor=10.0,
+                            min_history=3)
+    with pytest.warns(RuntimeWarning, match="non-finite"):
+        assert wd.observe(0, _summary(nonfinite_grads_max=3.0)) == "warn"
+    for e in range(3):
+        wd.observe(e, _summary(divergence_max=1.0))
+    with pytest.warns(RuntimeWarning, match="running median"):
+        assert wd.observe(3, _summary(divergence_max=100.0)) == "warn"
+    assert wd.trips == 2
+
+
+def test_watchdog_halt_raises():
+    wd = DivergenceWatchdog(policy="halt")
+    with pytest.raises(TrainingDiverged, match="non-finite"):
+        wd.observe(5, _summary(loss_nonfinite=2.0))
+
+
+def test_watchdog_rollback_budget_then_escalates():
+    wd = DivergenceWatchdog(policy="rollback", max_rollbacks=1)
+    assert wd.observe(0, _summary(nonfinite_grads_max=1.0)) == "rollback"
+    assert wd.pending_rollback is not None
+    wd.rolled_back()
+    assert wd.pending_rollback is None and wd.rollbacks == 1
+    with pytest.raises(TrainingDiverged, match="budget of 1 exhausted"):
+        wd.observe(1, _summary(nonfinite_grads_max=1.0))
+
+
+def test_watchdog_divergence_needs_positive_median():
+    # all-zero history (e.g. a no-commit rule) must never divide by zero or
+    # trip on the first nonzero drift
+    wd = DivergenceWatchdog(policy="halt", min_history=2)
+    for e in range(3):
+        assert wd.observe(e, _summary(divergence_max=0.0)) is None
+    assert wd.observe(3, _summary(divergence_max=5.0)) is None
+
+
+# ------------------------------------------- watchdog through the trainers
+
+def _diverging_trainer(lr=1e38, **kw):
+    return dk.DOWNPOUR(_mlp(), loss="categorical_crossentropy",
+                       worker_optimizer=("sgd", {"learning_rate": lr}),
+                       num_workers=2, batch_size=16, num_epoch=4,
+                       communication_window=2, seed=7, **kw)
+
+
+def test_watchdog_halt_stops_forced_nonfinite_run_within_one_epoch(monkeypatch):
+    telemetry.configure(False)
+    telemetry.dynamics.configure(enabled=True, watchdog="halt")
+    x, onehot = _toy()
+    epochs_seen = []
+    real = telemetry.dynamics.summarize
+
+    def spy(dyn, loss=None):
+        epochs_seen.append(len(epochs_seen))
+        return real(dyn, loss=loss)
+
+    monkeypatch.setattr(telemetry.dynamics, "summarize", spy)
+    with pytest.raises(TrainingDiverged, match="non-finite"):
+        _diverging_trainer().train(from_numpy(x, onehot))
+    # lr=1e38 corrupts the very first epoch; the watchdog must stop the run
+    # at that epoch's summary, not epochs later
+    assert len(epochs_seen) == 1
+
+
+def test_watchdog_rollback_restores_checkpoint_and_continues(tmp_path, monkeypatch):
+    """Policy 'rollback': a single poisoned epoch triggers one restore from
+    the last checkpoint, training then runs to completion, and the restore
+    really hits CheckpointManager.restore with the pre-divergence step."""
+    from distkeras_tpu import checkpoint as ckpt_mod
+
+    telemetry.configure(True)
+    telemetry.metrics.reset()
+    telemetry.dynamics.configure(enabled=True, watchdog="rollback")
+
+    real = telemetry.dynamics.summarize
+    calls = []
+
+    def poisoned(dyn, loss=None):
+        s = real(dyn, loss=loss)
+        calls.append(s)
+        if len(calls) == 3:  # epoch index 2
+            s["nonfinite_grads_max"] = 1.0
+        return s
+
+    monkeypatch.setattr(telemetry.dynamics, "summarize", poisoned)
+
+    restore_steps = []
+    orig_restore = ckpt_mod.CheckpointManager.restore
+
+    def spy_restore(self, like=None, step=None):
+        restore_steps.append(step)
+        return orig_restore(self, like=like, step=step)
+
+    monkeypatch.setattr(ckpt_mod.CheckpointManager, "restore", spy_restore)
+
+    x, onehot = _toy()
+    t = dk.DOWNPOUR(_mlp(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=2, batch_size=16, num_epoch=5,
+                    communication_window=2, seed=7,
+                    checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=1)
+    t.train(from_numpy(x, onehot))
+
+    # one restore, from the checkpoint saved after healthy epoch 1 (step 2),
+    # and all 5 epochs produced a summary — training continued past the trip
+    assert restore_steps == [2]
+    assert len(calls) == 5
+    snap = telemetry.metrics.snapshot()
+    assert snap["dynamics_watchdog_trips_total"]["value"] == 1.0
+    assert snap["dynamics_rollbacks_total"]["value"] == 1.0
+
+
+def test_watchdog_rollback_before_any_checkpoint_halts(tmp_path, monkeypatch):
+    telemetry.configure(False)
+    telemetry.dynamics.configure(enabled=True, watchdog="rollback")
+    real = telemetry.dynamics.summarize
+
+    def poisoned(dyn, loss=None):
+        s = real(dyn, loss=loss)
+        s["nonfinite_grads_max"] = 1.0  # poisoned from the very first epoch
+        return s
+
+    monkeypatch.setattr(telemetry.dynamics, "summarize", poisoned)
+    x, onehot = _toy()
+    t = dk.DOWNPOUR(_mlp(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=2, batch_size=16, num_epoch=3,
+                    communication_window=2, seed=7,
+                    checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=1)
+    with pytest.raises(TrainingDiverged, match="no checkpoint has been saved"):
+        t.train(from_numpy(x, onehot))
+
+
+def test_rollback_policy_requires_checkpoint_dir():
+    telemetry.configure(False)
+    telemetry.dynamics.configure(enabled=True, watchdog="rollback")
+    x, onehot = _toy()
+    t = dk.DOWNPOUR(_mlp(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=2, batch_size=16, num_epoch=2,
+                    communication_window=2, seed=7)
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        t.train(from_numpy(x, onehot))

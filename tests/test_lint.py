@@ -137,6 +137,28 @@ def test_dk106_timestamps_and_suppression():
     assert 36 not in lines  # perf_counter duration is the blessed idiom
 
 
+def test_dk107_finiteness_fixture():
+    got, _ = _run("dk107_finiteness.py", ["DK107"])
+    assert got == [
+        ("DK107", 11),  # bool(jnp.isnan(...)) in loop body
+        ("DK107", 13),  # .item() on a finiteness check per step
+        ("DK107", 14),  # np.asarray hostification
+        ("DK107", 15),  # jax.device_get hostification
+        ("DK107", 20),  # while-test through .any()
+        ("DK107", 28),  # if-test through jnp.any reduction
+        ("DK107", 35),  # assert syncing every step
+    ]
+
+
+def test_dk107_in_graph_and_suppression():
+    got, _ = _run("dk107_finiteness.py", ["DK107"])
+    lines = [ln for _, ln in got]
+    assert 41 not in lines  # suppressed
+    assert 46 not in lines  # jnp.where masking stays on device
+    assert 47 not in lines  # summed non-finite counter stays on device
+    assert 53 not in lines  # one-off host check outside any loop
+
+
 # ------------------------------------------------------------ machinery
 
 def test_file_wide_suppression(tmp_path):
@@ -181,7 +203,7 @@ def test_baseline_cancels_and_reports_stale(tmp_path):
 
 def test_all_rules_registered():
     assert sorted(all_rules()) == [
-        "DK101", "DK102", "DK103", "DK104", "DK105", "DK106",
+        "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
     ]
 
 

@@ -245,6 +245,70 @@ def test_prometheus_golden():
     assert reg.to_prometheus() == golden
 
 
+# ---------------------------------------------------------- fleet merging
+
+def _job_snapshots():
+    """Two job snapshots with overlapping metrics and DIFFERENT histogram
+    ladders — the shape the golden file pins."""
+    snap1 = {
+        "jobs_trained_total": {"type": "counter", "value": 3.0},
+        "dynamics_grad_norm": {"type": "gauge", "value": 1.5},
+        "phase_step_seconds": {"type": "histogram", "sum": 1.9, "count": 5,
+                               "buckets": {"0.1": 2, "1": 5, "+Inf": 5}},
+    }
+    snap2 = {
+        "jobs_trained_total": {"type": "counter", "value": 2.0},
+        "dynamics_grad_norm": {"type": "gauge", "value": 2.5},
+        "phase_step_seconds": {"type": "histogram", "sum": 6.0, "count": 4,
+                               "buckets": {"0.25": 1, "1": 3, "10": 4,
+                                           "+Inf": 4}},
+    }
+    return snap1, snap2
+
+
+def test_merge_snapshots_counters_gauges_histograms():
+    from distkeras_tpu.telemetry.metrics import merge_snapshots
+
+    merged = merge_snapshots(list(_job_snapshots()))
+    assert merged["jobs_trained_total"] == {"type": "counter", "value": 5.0}
+    g = merged["dynamics_grad_norm"]
+    assert (g["value"], g["mean"]) == (2.5, 2.0)  # max + mean across jobs
+    h = merged["phase_step_seconds"]
+    assert h["sum"] == pytest.approx(7.9)
+    assert h["count"] == 9
+    # union ladder with cumulative counts carried forward exactly: snap1
+    # contributes its le=0.1 count at 0.25, its le=1 count at 10
+    assert h["buckets"] == {"0.1": 2, "0.25": 3, "1": 8, "10": 9, "+Inf": 9}
+
+
+def test_merge_snapshots_type_conflict_and_identity():
+    from distkeras_tpu.telemetry.metrics import merge_snapshots
+
+    snap1, _ = _job_snapshots()
+    merged = merge_snapshots([snap1])
+    # counters/histograms are identity; gauges always carry the fleet shape
+    # (max + mean) so the schema is stable as the fleet grows
+    assert merged["jobs_trained_total"] == snap1["jobs_trained_total"]
+    assert merged["phase_step_seconds"] == snap1["phase_step_seconds"]
+    assert merged["dynamics_grad_norm"] == {"type": "gauge", "value": 1.5,
+                                            "mean": 1.5}
+    assert merge_snapshots([]) == {}
+    with pytest.raises(ValueError):
+        merge_snapshots([snap1, {"jobs_trained_total":
+                                 {"type": "gauge", "value": 1.0}}])
+
+
+def test_fleet_aggregate_prometheus_golden():
+    from distkeras_tpu.telemetry.metrics import (
+        merge_snapshots,
+        prometheus_from_snapshot,
+    )
+
+    merged = merge_snapshots(list(_job_snapshots()))
+    golden = open(os.path.join(GOLDEN, "telemetry_aggregate.txt")).read()
+    assert prometheus_from_snapshot(merged) == golden
+
+
 # -------------------------------------------------------- daemon round-trip
 
 @pytest.fixture
@@ -270,6 +334,75 @@ def test_daemon_metrics_verb_roundtrip(punchcard):
 def test_daemon_metrics_verb_requires_secret(punchcard):
     reply = Job("127.0.0.1", punchcard.port, secret="wrong").metrics()
     assert reply["status"] == "denied"
+
+
+# Jobs that report the exact snapshots the aggregate golden pins: counter 3
+# + gauge 1.5 + a (0.1, 1) histogram ladder, vs counter 2 + gauge 2.5 + a
+# (0.25, 1, 10) ladder.
+_FLEET_JOB = """\
+from distkeras_tpu import telemetry
+
+telemetry.metrics.counter("jobs_trained_total").inc({inc})
+telemetry.metrics.gauge("dynamics_grad_norm").set({gauge})
+h = telemetry.metrics.histogram("phase_step_seconds", buckets={buckets})
+for v in {observations}:
+    h.observe(v)
+telemetry.flush()
+"""
+
+
+def test_daemon_fleet_aggregate_roundtrip_matches_golden(punchcard, monkeypatch):
+    """Acceptance: two jobs run under the daemon (each in its own telemetry
+    dir), and the ``aggregate`` verb returns the merged fleet snapshot —
+    byte-identical to the committed Prometheus golden."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("PYTHONPATH", repo)  # jobs run from the daemon workdir
+    scripts = [
+        _FLEET_JOB.format(inc=3, gauge=1.5, buckets=(0.1, 1.0),
+                          observations=(0.05, 0.05, 0.3, 0.5, 1.0)),
+        _FLEET_JOB.format(inc=2, gauge=2.5, buckets=(0.25, 1.0, 10.0),
+                          observations=(0.2, 0.9, 1.0, 3.9)),
+    ]
+    for script in scripts:
+        job = Job("127.0.0.1", punchcard.port, secret="s3cret", script=script)
+        job.submit()
+        st = job.wait(timeout=120)
+        assert st["status"] == "finished", st["output"]
+
+    agg = Job("127.0.0.1", punchcard.port, secret="s3cret").aggregate()
+    assert agg["status"] == "ok"
+    assert agg["jobs"] == 2
+    assert agg["snapshot"]["jobs_trained_total"] == {"type": "counter",
+                                                     "value": 5.0}
+    golden = open(os.path.join(GOLDEN, "telemetry_aggregate.txt")).read()
+    assert agg["prometheus"] == golden
+    # the metrics verb carries the same fleet view alongside the daemon's
+    # own registry
+    fleet = Job("127.0.0.1", punchcard.port, secret="s3cret").metrics()["fleet"]
+    assert fleet["snapshot"] == agg["snapshot"]
+
+    # flush-on-job-finish: each job's telemetry landed in its own dir, and
+    # the daemon counted + flushed its own registry per job
+    tel_root = os.path.join(punchcard.workdir, "telemetry")
+    per_job = [d for d in os.listdir(tel_root)
+               if any(f.startswith("metrics_")
+                      for f in os.listdir(os.path.join(tel_root, d)))]
+    assert len(per_job) == 2
+    assert telemetry.metrics.snapshot()[
+        "punchcard_jobs_finished_total"]["value"] == 2.0
+
+
+def test_daemon_flush_on_stop(tmp_path):
+    # clean_telemetry points DISTKERAS_TELEMETRY_DIR at tmp_path; stop()
+    # must write the daemon's trace/metrics there instead of waiting for
+    # interpreter exit (daemons are typically killed, not exited)
+    server = PunchcardServer(port=0, secret="x")
+    server.start()
+    telemetry.metrics.counter("punchcard_smoke_total").inc()
+    server.stop()
+    files = os.listdir(tmp_path)
+    assert any(f.startswith("metrics_") for f in files)
+    assert any(f.startswith("trace_") for f in files)
 
 
 # ------------------------------------------------------------- ScalarLogger

@@ -2,6 +2,7 @@
 
 from tools.dklint.checkers import (  # noqa: F401 — registration side effects
     donation,
+    finiteness,
     host_sync,
     locks,
     mesh_axes,

@@ -1,0 +1,134 @@
+"""DK107 — device finiteness checks pulled to host inside a step loop.
+
+``jnp.isnan(...)`` / ``jnp.isinf(...)`` / ``jnp.isfinite(...)`` produce
+device arrays.  Forcing one to a Python value — ``bool(...)``, ``.item()``,
+``np.asarray(...)``, or using it as an ``if``/``while`` condition — blocks
+the host on the device stream.  Done once after training that is harmless;
+done inside a step loop it serialises every iteration behind a transfer and
+defeats dispatch pipelining (the same pathology DK101 polices for jitted
+bodies, surfacing here on the host driver loop).
+
+The blessed alternatives keep the check on device: mask in-graph with
+``jnp.where(jnp.isnan(x), ...)``, accumulate a summed non-finite counter
+through the stats pytree, or let ``telemetry.dynamics`` check health at
+epoch granularity where one sync per epoch is the contract.
+
+Heuristic: a finiteness call is flagged when (a) a ``for``/``while`` loop
+is an ancestor and (b) walking up through expression nesting reaches a
+hostifier — a ``bool``/``float``/``int`` cast, an ``.item()``/``.tolist()``
+access, ``np.asarray``/``np.array``/``jax.device_get``, or the test of an
+``if``/``while``/``assert``.  Device-side reductions (``.any()``,
+``jnp.any``, ``jnp.sum``, ...) are transparent: the walk continues through
+them, so ``bool(jnp.isnan(x).any())`` flags.  Any other call is opaque —
+the value is presumed consumed in-graph (``jnp.where(jnp.isnan(x), ...)``
+stays clean), as does anything outside a loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+
+FINITENESS_CALLS = {
+    f"{base}.{fn}"
+    for base in ("jnp", "jax.numpy")
+    for fn in ("isnan", "isinf", "isfinite")
+}
+
+# Python-level casts that force a transfer when applied to a device array.
+_HOST_CASTS = {"bool", "float", "int"}
+
+# Calls that materialise their argument on host.
+_HOST_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get",
+}
+
+# Attribute accesses that pull to host when invoked.
+_HOST_METHODS = {"item", "tolist"}
+
+# Device-side reductions/transforms the walk looks through: the result is
+# still a device array, so an enclosing hostifier is what matters.
+_TRANSPARENT_CALLS = {
+    f"{base}.{fn}"
+    for base in ("jnp", "jax.numpy")
+    for fn in ("any", "all", "sum", "max", "min", "mean",
+               "logical_not", "logical_and", "logical_or")
+}
+
+
+def _hostified(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> bool:
+    """Does the value of ``node`` visibly reach the host?  Walks the parent
+    chain through expression nesting and stops at the first verdict."""
+    prev: ast.AST = node
+    cur: Optional[ast.AST] = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call):
+            if prev is not cur.func:  # judged the method attr already
+                fn = cur.func
+                if isinstance(fn, ast.Name) and fn.id in _HOST_CASTS:
+                    return True
+                name = call_name(cur)
+                if name in _HOST_CALLS:
+                    return True
+                if name not in _TRANSPARENT_CALLS:
+                    return False  # opaque call: consumed in-graph
+        elif isinstance(cur, ast.Attribute):
+            if cur.attr in _HOST_METHODS:
+                return True
+            # other attrs (.any, .shape, ...) are transparent
+        elif isinstance(cur, (ast.If, ast.While, ast.Assert)):
+            return prev is cur.test  # condition ⇒ implicit bool() ⇒ sync
+        elif isinstance(cur, (ast.stmt, ast.comprehension, ast.keyword)):
+            return False
+        prev, cur = cur, parents.get(cur)
+    return False
+
+
+def _in_loop(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+@register
+class FinitenessHostPull(Checker):
+    rule = "DK107"
+    name = "finiteness-host-pull"
+    description = (
+        "jnp.isnan/isinf/isfinite result pulled to host inside a step "
+        "loop; mask in-graph or check at epoch granularity"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(fi.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in FINITENESS_CALLS:
+                continue
+            if not _in_loop(node, parents):
+                continue
+            if not _hostified(node, parents):
+                continue
+            yield Finding(
+                path=fi.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                rule=self.rule,
+                message=(
+                    "finiteness check forced to host inside a step loop "
+                    "blocks on the device stream every iteration; mask "
+                    "in-graph (jnp.where / summed non-finite counts) or "
+                    "check at epoch granularity via telemetry.dynamics"
+                ),
+            )
