@@ -14,7 +14,7 @@ follows.
 
 __version__ = "0.1.0"
 
-from distkeras_tpu import frame, utils
+from distkeras_tpu import frame, sanitizer, utils
 from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator, PerplexityEvaluator
 from distkeras_tpu.frame import (
     DataFrame,
@@ -80,5 +80,6 @@ __all__ = [
     "DenseTransformer",
     "StandardScaleTransformer",
     "frame",
+    "sanitizer",
     "utils",
 ]

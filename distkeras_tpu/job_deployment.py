@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional
 
 from distkeras_tpu import telemetry
 from distkeras_tpu.networking import connect, recv_data, send_data
+from distkeras_tpu.sanitizer import lockwatch
 
 __all__ = ["Job", "PunchcardServer"]
 
@@ -73,9 +74,14 @@ class PunchcardServer:
         self.port = port
         self.secret = secret
         self.workdir = workdir or tempfile.mkdtemp(prefix="punchcard_")
-        self.jobs: Dict[str, dict] = {}
+        # Under DISTKERAS_SANITIZE the cv is wrapped by the lock-order
+        # watchdog (acquisition-order graph, off-lock wait/notify checks)
+        # and the jobs dict rejects mutation off the cv — DK105's runtime
+        # twin.  With the flag off both are the stock objects.
+        self._cv = lockwatch.maybe_wrap(threading.Condition(), "punchcard.cv")
+        self.jobs: Dict[str, dict] = lockwatch.guard_map({}, self._cv,
+                                                         "punchcard.jobs")
         self._queue: list[str] = []
-        self._cv = threading.Condition()
         self._running = False
         self._sock: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []

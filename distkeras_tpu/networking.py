@@ -140,8 +140,14 @@ def _decode(payload: bytes) -> Any:
 
 def send_data(sock: socket.socket, obj: Any) -> None:
     """Length-prefixed message send (reference parity: ``send_data``)."""
+    from distkeras_tpu.sanitizer import lockwatch
+
     payload = _encode(obj)
-    sock.sendall(_MAGIC + struct.pack("!Q", len(payload)) + payload)
+    # one frame must hit the wire atomically per socket: the sanitizer's
+    # exclusivity guard flags concurrent sends from two threads, which
+    # would interleave length-prefixed frames and tear the stream
+    with lockwatch.exclusive(sock, "send_data on one socket"):
+        sock.sendall(_MAGIC + struct.pack("!Q", len(payload)) + payload)
 
 
 def _recvall(sock: socket.socket, n: int) -> bytes:
@@ -157,10 +163,14 @@ def _recvall(sock: socket.socket, n: int) -> bytes:
 
 def recv_data(sock: socket.socket) -> Any:
     """Length-prefixed message receive (reference parity: ``recv_data``)."""
-    header = _recvall(sock, 12)
-    if header[:4] != _MAGIC:
-        raise ValueError("bad message magic")
-    (length,) = struct.unpack("!Q", header[4:])
-    if length > _MAX_MESSAGE:
-        raise ValueError(f"message too large: {length}")
-    return _decode(_recvall(sock, length))
+    from distkeras_tpu.sanitizer import lockwatch
+
+    with lockwatch.exclusive(sock, "recv_data on one socket"):
+        header = _recvall(sock, 12)
+        if header[:4] != _MAGIC:
+            raise ValueError("bad message magic")
+        (length,) = struct.unpack("!Q", header[4:])
+        if length > _MAX_MESSAGE:
+            raise ValueError(f"message too large: {length}")
+        payload = _recvall(sock, length)
+    return _decode(payload)

@@ -37,6 +37,7 @@ from flax import struct
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from distkeras_tpu import sanitizer as sanitizer_mod
 from distkeras_tpu import telemetry
 from distkeras_tpu.algorithms.base import CommitCtx, UpdateRule
 from distkeras_tpu.telemetry import dynamics as dynamics_mod
@@ -254,6 +255,11 @@ class WindowedEngine:
         # identical to a build without the feature (pinned in
         # tests/test_dynamics.py).
         self._dynamics = dynamics_mod.enabled()
+        # Runtime sanitizer (distkeras_tpu.sanitizer), same convention: one
+        # cached bool read at build, zero per-dispatch cost when off and
+        # byte-identical lowered programs either way (the guards are pure
+        # host-side wrappers — pinned in tests/test_sanitizer.py).
+        self._sanitize = sanitizer_mod.enabled()
         self._epoch_fns = {}
 
     # ------------------------------------------------------------------ init
@@ -963,6 +969,24 @@ class WindowedEngine:
         return jax.jit(epoch_fn, donate_argnums=(0,))
 
     # ----------------------------------------------------------------- public
+    def _dispatch(self, fn, state, xs, ys):
+        """Dispatch one donating epoch program.
+
+        With ``DISTKERAS_SANITIZE`` on, the dispatch (including any cache-miss
+        trace) runs under the sanitizer's transfer guard — a host sync hidden
+        in the hot loop raises in strict mode, naming the enclosing telemetry
+        span — and the donated input state is poisoned afterwards so a stale
+        read fails on every backend, not just where donation really aliases
+        (DK101/DK103's runtime twins)."""
+        if not self._sanitize:
+            return fn(state, xs, ys)
+        from distkeras_tpu.sanitizer import donation, transfer
+
+        with transfer.guard("epoch_dispatch"):
+            out = fn(state, xs, ys)
+        donation.poison(state, label="epoch state (donate_argnums=0)")
+        return out
+
     def _dispatch_with_spans(self, fn, state, xs, ys, n_windows):
         """Telemetry-enabled dispatch: wrap the (normally fully async) epoch
         program in window/step/commit spans.
@@ -978,7 +1002,7 @@ class WindowedEngine:
         disabled path dispatches directly with zero added syncs."""
         with telemetry.trace.span("window", windows=n_windows):
             with telemetry.trace.span("step", phase="step"):
-                new_state, stats = fn(state, xs, ys)
+                new_state, stats = self._dispatch(fn, state, xs, ys)
                 jax.block_until_ready(stats["loss"])
             with telemetry.trace.span("commit", phase="commit"):
                 jax.block_until_ready(new_state.center_params)
@@ -1008,7 +1032,7 @@ class WindowedEngine:
         with self.mesh:
             if sync_telemetry and telemetry.enabled():
                 return self._dispatch_with_spans(fn, state, xs, ys, int(xs.shape[1]))
-            return fn(state, xs, ys)
+            return self._dispatch(fn, state, xs, ys)
 
     def run_epochs(
         self,
@@ -1049,7 +1073,7 @@ class WindowedEngine:
         with self.mesh:
             if telemetry.enabled():
                 return self._dispatch_with_spans(fn, state, xs, ys, n_windows)
-            return fn(state, xs, ys)
+            return self._dispatch(fn, state, xs, ys)
 
     def clear_program_cache(self, keep_multi: Optional[tuple] = None) -> None:
         """Drop cached compiled epoch programs.

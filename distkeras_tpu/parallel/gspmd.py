@@ -48,6 +48,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.algorithms.base import UpdateRule
 from distkeras_tpu.models.adapter import ModelAdapter
 from distkeras_tpu.parallel.engine import (
@@ -427,8 +428,21 @@ class GSPMDEngine(WindowedEngine):
     # --------------------------------------------------------------- sharding
     def shard_batches(self, xs: np.ndarray, ys: np.ndarray):
         sharding = NamedSharding(self.mesh, P(WORKER_AXIS))
-        with self.mesh:
-            return (
-                jax.make_array_from_callback(xs.shape, sharding, lambda idx: xs[idx]),
-                jax.make_array_from_callback(ys.shape, sharding, lambda idx: ys[idx]),
-            )
+
+        def _put():
+            with self.mesh:
+                return (
+                    jax.make_array_from_callback(xs.shape, sharding, lambda idx: xs[idx]),
+                    jax.make_array_from_callback(ys.shape, sharding, lambda idx: ys[idx]),
+                )
+
+        if not telemetry.enabled():
+            return _put()
+        # same honest-transfer span as the base class (blocks so the span
+        # covers the copy, not just the enqueue) — parity for bench.py's
+        # phase breakdown under the GSPMD engine
+        with telemetry.trace.span("h2d", phase="h2d",
+                                  bytes=int(xs.nbytes) + int(ys.nbytes)):
+            out = _put()
+            jax.block_until_ready(out)
+        return out

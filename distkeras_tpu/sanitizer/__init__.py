@@ -1,0 +1,52 @@
+"""``distkeras_tpu.sanitizer`` — runtime twins of dklint's static rules.
+
+Enabled by ``DISTKERAS_SANITIZE=1`` (record) or ``strict`` (raise):
+
+* :mod:`.transfer` — DK101's twin: the jitted epoch/window dispatch runs
+  under ``jax.transfer_guard`` plus a Python-level interposition on
+  ``jax.Array``'s scalar-conversion methods, so a host sync hidden in the
+  hot loop raises (strict) or bumps ``sanitizer_transfer_violations``
+  (record), with the enclosing telemetry span named;
+* :mod:`.donation` — DK103's twin: donated-but-still-live buffers are
+  poisoned at engine step boundaries so post-donation reads fail on every
+  backend, not just where donation really aliases;
+* :mod:`.lockwatch` — DK105's twin: wrapped locks record per-thread
+  acquisition order (inversions and off-lock cv-waits are flagged), and
+  guarded shared dicts/sockets catch off-lock mutation and torn frames.
+
+All guards collapse to no-ops when the flag is off; the engines read the
+mode once at build (``self._sanitize``) so the disabled path stays a single
+cached bool and lowered programs are byte-identical (pinned in
+tests/test_sanitizer.py, the telemetry/dynamics convention).
+"""
+
+from __future__ import annotations
+
+from distkeras_tpu.sanitizer import donation, lockwatch, runtime, transfer
+from distkeras_tpu.sanitizer.lockwatch import LockOrderViolation
+from distkeras_tpu.sanitizer.runtime import (
+    SanitizerViolation,
+    configure,
+    enabled,
+    mode,
+    report,
+    strict,
+    violations,
+)
+from distkeras_tpu.sanitizer.transfer import TransferViolation
+
+__all__ = [
+    "LockOrderViolation",
+    "SanitizerViolation",
+    "TransferViolation",
+    "configure",
+    "donation",
+    "enabled",
+    "lockwatch",
+    "mode",
+    "report",
+    "runtime",
+    "strict",
+    "transfer",
+    "violations",
+]

@@ -1,0 +1,77 @@
+"""Donation guard — DK103's runtime twin.
+
+``run_epoch``'s program donates the input state (``donate_argnums=(0,)``).
+On backends where donation really aliases buffers, JAX already deletes the
+donated inputs and a stale read raises.  But donation can silently *not*
+happen — a sharding/layout mismatch, or a backend (CPU in some versions)
+that ignores the hint — and then a read-after-donate bug sits latent until
+the code first runs on a TPU.  The guard closes that gap: at every engine
+step boundary it **poisons** whatever the runtime left alive, so a
+post-donation read fails deterministically on every backend, right where
+DK103 would have flagged it statically.
+
+Poisoning uses ``Array.delete()`` — the donated handles are either already
+deleted (true aliasing) or about to be unreachable from the caller (the
+``run_epoch`` contract), so deleting them never changes a correct program.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from distkeras_tpu.sanitizer import runtime
+
+__all__ = ["poison", "stats", "reset_stats"]
+
+KIND = "donation"
+
+_lock = threading.Lock()
+_stats = {"poisoned": 0, "already_deleted": 0, "boundaries": 0}
+
+
+def poison(tree, label: str = "donated state") -> int:
+    """Delete every live ``jax.Array`` leaf of a donated pytree.
+
+    Returns how many leaves were still alive (i.e. the runtime did NOT
+    donate them — each one is a latent cross-backend divergence, counted in
+    the ``sanitizer_donation_poisoned`` gauge-like counter).  No-op when the
+    sanitizer is off."""
+    if not runtime.enabled():
+        return 0
+    import jax
+
+    poisoned = already = 0
+    for leaf in jax.tree.leaves(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        try:
+            if leaf.is_deleted():
+                already += 1
+                continue
+            leaf.delete()
+            poisoned += 1
+        except RuntimeError:  # deleted concurrently / non-deletable view
+            already += 1
+    with _lock:
+        _stats["poisoned"] += poisoned
+        _stats["already_deleted"] += already
+        _stats["boundaries"] += 1
+    if poisoned:
+        from distkeras_tpu.telemetry.metrics import metrics as _registry
+
+        _registry.counter(
+            "sanitizer_donation_poisoned",
+            help="donated-but-still-live buffers the sanitizer poisoned",
+        ).inc(poisoned)
+    return poisoned
+
+
+def stats() -> dict:
+    with _lock:
+        return dict(_stats)
+
+
+def reset_stats() -> None:
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0

@@ -1,0 +1,135 @@
+"""Transfer guard — DK101's runtime twin.
+
+Wraps the jitted epoch/window dispatch so a host<->device round-trip inside
+the hot loop is caught *as it executes*, not just statically:
+
+* ``jax.transfer_guard("disallow")`` arms XLA's own guard for the dynamic
+  extent of the dispatch (strict mode only): on accelerator backends any
+  implicit device-to-host or host-to-device copy raises.  On the CPU
+  backend arrays are host-resident and XLA's d2h guard never fires — which
+  is exactly why the second layer exists;
+* a Python-level interposition on ``jax.Array``'s scalar-conversion
+  methods (``item``/``tolist``/``__float__``/``__int__``/``__index__``/
+  ``__array__``): while a guard region is open on the current thread, any
+  of these on a concrete array is a host sync hidden in the hot loop (the
+  classic ``.item()`` in a jitted body, executing at trace time) and is
+  reported with the innermost open telemetry span attached.
+
+The interposition is installed once, only when the sanitizer is enabled —
+a disabled process never patches anything — and the patched methods cost
+one thread-local read when no guard is open.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from distkeras_tpu.sanitizer import runtime
+from distkeras_tpu.sanitizer.runtime import SanitizerViolation
+
+__all__ = ["TransferViolation", "guard"]
+
+KIND = "transfer"
+
+# jax.Array methods whose execution on a concrete array forces a
+# device->host materialisation (mirrors DK101's HOST_SYNC_METHODS).
+_SYNC_METHODS = ("item", "tolist", "__float__", "__int__", "__index__",
+                 "__array__")
+
+_tls = threading.local()  # .depth (int), .label (str)
+_install_lock = threading.Lock()
+_installed = False
+
+
+class TransferViolation(SanitizerViolation):
+    """A host<->device transfer executed inside a guarded hot loop."""
+
+
+def _span_context(label):
+    """'span <name>' when a telemetry span is open on this thread, else the
+    guard's static label — the violation message must name where in the
+    pipeline the sync happened either way."""
+    from distkeras_tpu import telemetry
+
+    span = telemetry.trace.current()
+    return f"span '{span}'" if span else f"guard '{label}'"
+
+
+def _violate(what):
+    label = getattr(_tls, "label", "?")
+    runtime.report(
+        KIND,
+        f"host transfer inside the hot loop ({_span_context(label)}): {what}",
+        TransferViolation,
+    )
+
+
+def _wrap(name, orig):
+    def guarded(self, *args, **kwargs):
+        if getattr(_tls, "depth", 0):
+            _violate(f"jax.Array.{name}() forces a device->host sync")
+        return orig(self, *args, **kwargs)
+
+    guarded.__name__ = name
+    guarded.__qualname__ = f"ArrayImpl.{name}"
+    return guarded
+
+
+def _install():
+    """Patch the concrete jax.Array class once per process (enabled mode
+    only).  ArrayImpl is the single concrete class behind every committed
+    array, so one patch covers all of them."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        try:
+            from jax._src.array import ArrayImpl
+        except ImportError:  # jax moved the class; fall back to a live array
+            import jax.numpy as jnp
+
+            ArrayImpl = type(jnp.zeros(()))
+        for name in _SYNC_METHODS:
+            orig = getattr(ArrayImpl, name, None)
+            if orig is not None:
+                setattr(ArrayImpl, name, _wrap(name, orig))
+        _installed = True
+
+
+@contextlib.contextmanager
+def guard(label: str):
+    """Guard the dynamic extent of one hot-loop dispatch.
+
+    No-op when the sanitizer is off.  In strict mode XLA's transfer guard is
+    armed as well, and its errors are re-raised as :class:`TransferViolation`
+    with the span context attached."""
+    if not runtime.enabled():
+        yield
+        return
+    _install()
+    prev_label = getattr(_tls, "label", None)
+    _tls.label = label
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    try:
+        if runtime.strict():
+            import jax
+
+            try:
+                with jax.transfer_guard("disallow"):
+                    yield
+            except TransferViolation:
+                raise
+            except Exception as e:  # XlaRuntimeError is backend-defined
+                text = str(e)
+                if "Disallowed" in text and "transfer" in text:
+                    raise TransferViolation(
+                        f"host transfer inside the hot loop "
+                        f"({_span_context(label)}): {text.splitlines()[0]}"
+                    ) from e
+                raise
+        else:
+            yield
+    finally:
+        _tls.depth -= 1
+        _tls.label = prev_label

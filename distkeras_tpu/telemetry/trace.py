@@ -117,6 +117,12 @@ class Tracer:
         stack.pop()
         return stack[-1] if stack else None
 
+    def current(self):
+        """Name of this thread's innermost open span, or ``None`` — used by
+        the sanitizer to attribute violations to the pipeline phase."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
     def _record(self, name, t0, t1, parent, attrs):
         ident = threading.get_ident()
         args = dict(attrs)

@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import warnings
 from typing import Any, List, Optional, Sequence
 
 import jax
 import numpy as np
 
+from distkeras_tpu import sanitizer
 from distkeras_tpu import telemetry
 from distkeras_tpu import workers as workers_mod
 from distkeras_tpu.data import epoch_arrays
@@ -637,6 +639,21 @@ class Trainer:
             # one file pair per process under DISTKERAS_TELEMETRY[_DIR]:
             # the Chrome trace (open in Perfetto) and a metrics snapshot
             telemetry.flush()
+        if sanitizer.enabled() and not sanitizer.strict():
+            # record mode: per-violation warnings fire once per guard kind,
+            # so close the fit with the full tally — the operator's cue to
+            # re-run strict (or dklint) before this reaches a TPU pod
+            recorded = sanitizer.violations()
+            if recorded:
+                kinds = sorted({k for k, _ in recorded})
+                warnings.warn(
+                    f"sanitizer recorded {len(recorded)} violation(s) during "
+                    f"this fit ({', '.join(kinds)} guard"
+                    f"{'s' if len(kinds) > 1 else ''}); see the sanitizer_* "
+                    "counters, or run with DISTKERAS_SANITIZE=strict to fail "
+                    "at the offending dispatch",
+                    RuntimeWarning,
+                )
         return engine, state, adapter
 
     def _train_chunked(

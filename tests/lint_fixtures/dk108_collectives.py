@@ -1,0 +1,81 @@
+"""DK108 fixture: collectives checked against the enclosing mapper's axes,
+and lax.cond branch-divergence.  Never imported — AST analysis only."""
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+
+mesh = Mesh(None, ("workers", "seq"))
+
+
+def body_ok(x):
+    return lax.psum(x, "workers")
+
+
+def body_bad(x):
+    return lax.psum(x, "replicas")
+
+
+sharded_ok = shard_map(body_ok, mesh=mesh, in_specs=P("workers"), out_specs=P())
+sharded_bad = shard_map(body_bad, mesh=mesh, in_specs=P("workers"), out_specs=P())
+
+
+def pbody(x):
+    return lax.pmean(x, "batch")
+
+
+pm = jax.pmap(pbody, axis_name="devices")
+
+
+def vbody_const(x):
+    return lax.psum(x, WORKER_AXIS)
+
+
+vm = jax.vmap(vbody_const, axis_name="workers")
+
+
+def inner(x):
+    return lax.psum(x, "seq") + lax.psum(x, "workers")
+
+
+def outer(x):
+    return jax.vmap(inner, axis_name="seq")(x)
+
+
+nested = shard_map(outer, mesh=mesh, in_specs=P("workers"), out_specs=P())
+
+
+def body_sup(x):
+    return lax.psum(x, "ghost")  # dklint: disable=DK108
+
+
+sup = shard_map(body_sup, mesh=mesh, in_specs=P("workers"), out_specs=P())
+
+
+# ---------------------------------------------------------- cond divergence
+
+def t_branch(x):
+    return lax.psum(x, "workers")
+
+
+def f_branch(x):
+    return x * 2.0
+
+
+def guarded(pred, x):
+    return lax.cond(pred, t_branch, f_branch, x)
+
+
+def t_same(x):
+    return lax.pmean(x, "workers")
+
+
+def f_same(x):
+    return lax.pmean(x, "workers")
+
+
+def balanced(pred, x):
+    return lax.cond(pred, t_same, f_same, x)

@@ -1,0 +1,52 @@
+"""DK109 fixture: Python control flow on traced parameters of functions
+passed *by name* to tracing wrappers.  Never imported — AST analysis only."""
+
+import jax
+
+
+def relu_or_zero(x):
+    if x > 0:
+        return x
+    return 0.0
+
+
+def clipped(x, lo):
+    while x > lo:
+        x = x - 1.0
+    return x
+
+
+def structural(x, y):
+    if x is None:
+        return y
+    if y.shape[0] > 2:
+        return y * 2.0
+    if isinstance(x, tuple):
+        return y
+    return x + y
+
+
+def static_ok(x, n):
+    if n > 3:
+        return x * n
+    return x
+
+
+def suppressed(x):
+    if x > 1:  # dklint: disable=DK109
+        return x
+    return 0.0
+
+
+@jax.jit
+def decorated(x):
+    if x > 0:  # DK102's territory, not DK109's
+        return x
+    return 0.0
+
+
+fast = jax.jit(relu_or_zero)
+clip = jax.vmap(clipped)
+struct = jax.jit(structural)
+stat = jax.jit(static_ok, static_argnums=(1,))
+sup = jax.jit(suppressed)

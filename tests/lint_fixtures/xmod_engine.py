@@ -1,0 +1,13 @@
+"""Cross-module fixture, hot half: the jitted step calls a helper imported
+from ``xmod_helper`` — the host sync lives in the *other* module, which
+only the interprocedural (v2) fixpoint reaches."""
+
+import jax
+
+from xmod_helper import leaky_norm, safe_scale
+
+
+@jax.jit
+def step(state):
+    penalty = leaky_norm(state)
+    return safe_scale(state, penalty)

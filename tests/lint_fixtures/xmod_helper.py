@@ -1,0 +1,16 @@
+"""Cross-module fixture, cold half: nothing in this file is jitted or
+passed to a tracing wrapper, so per-module (v1) analysis finds nothing.
+``leaky_norm`` only goes hot through ``xmod_engine``'s import."""
+
+import numpy as np
+
+
+def leaky_norm(tree):
+    total = 0.0
+    for leaf in tree:
+        total += float(np.asarray(leaf).sum())
+    return total
+
+
+def safe_scale(x, s):
+    return x * s

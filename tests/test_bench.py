@@ -198,7 +198,7 @@ def test_layer_microbench_builds_every_spec_kind():
         g = fn(p, x)
         gp = g[0] if isinstance(g, tuple) else g
         assert gp.shape == p.shape
-        assert jax.numpy.isfinite(gp).all()
+        assert jax.numpy.isfinite(gp).all()  # dklint: disable=DK107
 
 
 def test_layer_wall_descent_carry_stays_finite():
@@ -219,7 +219,7 @@ def test_layer_wall_descent_carry_stays_finite():
         gp, gx = fn(p, x)
         return (p - eps * gp, x - eps * gx), None
 
-    (p_out, x_out), _ = jax.jit(
+    (p_out, x_out), _ = jax.jit(  # dklint: disable=DK102 — one-shot test
         lambda p, x: lax.scan(body, (p, x), None, length=64)
     )(p, x)
     assert jnp.isfinite(p_out.astype(jnp.float32)).all()

@@ -14,6 +14,9 @@ pytestmark = pytest.mark.lint
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO_ROOT, "tests", "lint_fixtures")
 BASELINE = os.path.join(REPO_ROOT, "tools", "dklint", "baseline.json")
+SELFLINT_BASELINE = os.path.join(
+    REPO_ROOT, "tools", "dklint", "selflint_baseline.json"
+)
 
 sys.path.insert(0, REPO_ROOT)
 
@@ -159,6 +162,66 @@ def test_dk107_in_graph_and_suppression():
     assert 53 not in lines  # one-off host check outside any loop
 
 
+def test_dk108_collectives_fixture():
+    got, _ = _run("dk108_collectives.py", ["DK108"])
+    assert got == [
+        ("DK108", 19),  # psum over an axis the shard_map mesh never binds
+        ("DK108", 27),  # pmean over 'batch' under pmap(axis_name="devices")
+        ("DK108", 69),  # lax.cond branches with different collectives
+    ]
+
+
+def test_dk108_bound_axes_and_suppression():
+    got, _ = _run("dk108_collectives.py", ["DK108"])
+    lines = [ln for _, ln in got]
+    assert 16 not in lines  # axis bound by the shard_map mesh
+    assert 35 not in lines  # axis via *_AXIS constant matches vmap axis_name
+    assert 42 not in lines  # nested vmap: outer shard_map axes still bound
+    assert 53 not in lines  # suppressed
+    assert 85 not in lines  # cond with identical collectives per branch
+
+
+def test_dk109_traced_branch_fixture():
+    got, _ = _run("dk109_traced_branch.py", ["DK109"])
+    assert got == [
+        ("DK109", 8),   # if on traced param of jit-by-name fn
+        ("DK109", 14),  # while on traced param 'x'
+        ("DK109", 14),  # ... and on traced param 'lo'
+    ]
+
+
+def test_dk109_exemptions_and_suppression():
+    got, _ = _run("dk109_traced_branch.py", ["DK109"])
+    lines = [ln for _, ln in got]
+    assert 20 not in lines  # `x is None` structure dispatch
+    assert 22 not in lines  # .shape comparison is trace-time static
+    assert 24 not in lines  # isinstance
+    assert 30 not in lines  # static_argnums at the jit call site
+    assert 36 not in lines  # suppressed
+    assert 43 not in lines  # @jax.jit-decorated fn is DK102's territory
+
+
+# ------------------------------------------------------ interprocedural v2
+
+def test_cross_module_host_sync_found_by_v2():
+    """The helper's np.asarray is invisible per-module (v1) but hot once the
+    jitted caller in the other file is analyzed alongside it."""
+    pair = [os.path.join(FIXTURES, "xmod_engine.py"),
+            os.path.join(FIXTURES, "xmod_helper.py")]
+    findings, _ = analyze(pair, root=REPO_ROOT, select=["DK101"])
+    assert [(f.rule, os.path.basename(f.path), f.line) for f in findings] == [
+        ("DK101", "xmod_helper.py", 11),
+    ]
+
+
+def test_cross_module_helper_alone_is_cold():
+    findings, _ = analyze(
+        [os.path.join(FIXTURES, "xmod_helper.py")],
+        root=REPO_ROOT, select=["DK101"],
+    )
+    assert findings == []
+
+
 # ------------------------------------------------------------ machinery
 
 def test_file_wide_suppression(tmp_path):
@@ -186,6 +249,56 @@ def test_disable_all(tmp_path):
     assert findings == []
 
 
+def test_decorator_line_suppression_covers_the_def(tmp_path):
+    """A trailing directive on a decorator line suppresses findings anywhere
+    in the decorated function — previously it only covered the decorator's
+    own line, which can never carry the finding."""
+    src = (
+        "import jax\n"
+        "@jax.jit  # dklint: disable=DK101\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, _ = analyze([str(p)], root=str(tmp_path), select=["DK101"])
+    assert findings == []
+
+
+def test_decorator_line_suppression_is_scoped(tmp_path):
+    """The decorator-line directive covers only its own function."""
+    src = (
+        "import jax\n"
+        "@jax.jit  # dklint: disable=DK101\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+        "@jax.jit\n"
+        "def g(x):\n"
+        "    return x.item()\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, _ = analyze([str(p)], root=str(tmp_path), select=["DK101"])
+    assert [(f.rule, f.line) for f in findings] == [("DK101", 7)]
+
+
+def test_multi_rule_disable(tmp_path):
+    src = (
+        "import jax\n"
+        "@jax.jit  # dklint: disable=DK101,DK102\n"
+        "def f(x, n):\n"
+        "    if n > 0:\n"
+        "        return x.item()\n"
+        "    return x\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings, _ = analyze(
+        [str(p)], root=str(tmp_path), select=["DK101", "DK102"]
+    )
+    assert findings == []
+
+
 def test_baseline_cancels_and_reports_stale(tmp_path):
     src = "import jax\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n"
     p = tmp_path / "mod.py"
@@ -204,14 +317,16 @@ def test_baseline_cancels_and_reports_stale(tmp_path):
 def test_all_rules_registered():
     assert sorted(all_rules()) == [
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
+        "DK108", "DK109",
     ]
 
 
 def test_baseline_entries_have_reasons():
-    entries = load_baseline(BASELINE)
-    assert entries, "committed baseline should not be empty-yet-present"
-    for e in entries:
-        assert e.get("reason", "").strip(), f"baseline entry lacks a reason: {e}"
+    for path in (BASELINE, SELFLINT_BASELINE):
+        entries = load_baseline(path)
+        assert entries, f"{path} should not be empty-yet-present"
+        for e in entries:
+            assert e.get("reason", "").strip(), f"baseline entry lacks a reason: {e}"
 
 
 # ---------------------------------------------------------------- the gate
@@ -223,6 +338,22 @@ def test_package_is_clean_modulo_baseline():
     findings, files = analyze([pkg], root=REPO_ROOT)
     new, _stale = apply_baseline(findings, load_baseline(BASELINE), files)
     assert new == [], "new dklint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_tools_and_tests_clean_modulo_selflint_baseline():
+    """The self-lint gate: dklint over its own sources and the test tree
+    yields nothing the selflint baseline (deliberate fixture violations)
+    does not account for."""
+    findings, files = analyze(
+        [os.path.join(REPO_ROOT, "tools"), os.path.join(REPO_ROOT, "tests")],
+        root=REPO_ROOT,
+    )
+    new, _stale = apply_baseline(
+        findings, load_baseline(SELFLINT_BASELINE), files
+    )
+    assert new == [], "new self-lint findings:\n" + "\n".join(
         f.render() for f in new
     )
 
@@ -243,6 +374,65 @@ def test_cli_exit_codes():
     )
     assert dirty.returncode == 1
     assert "DK101" in dirty.stdout
+
+
+def test_cli_prune_baseline_roundtrip(tmp_path):
+    """--prune-baseline drops entries matching nothing and keeps (with
+    reasons) the ones still earning their grandfathering."""
+    src = "import jax\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n"
+    (tmp_path / "mod.py").write_text(src)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({
+        "version": 1,
+        "findings": [
+            {"path": "mod.py", "rule": "DK102",
+             "text": "return jax.jit(lambda v: v)(x)", "reason": "live"},
+            {"path": "mod.py", "rule": "DK102",
+             "text": "this line is long gone", "reason": "stale"},
+        ],
+    }))
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    pruned = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", "mod.py",
+         "--root", str(tmp_path), "--baseline", str(baseline),
+         "--prune-baseline"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+    )
+    assert pruned.returncode == 0, pruned.stdout + pruned.stderr
+    assert "pruned 1 stale entry, kept 1" in pruned.stdout
+    doc = json.loads(baseline.read_text())
+    assert [e["reason"] for e in doc["findings"]] == ["live"]
+    # round-trip: the pruned baseline still cancels the live finding
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", "mod.py",
+         "--root", str(tmp_path), "--baseline", str(baseline)],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    # pruning again is a no-op
+    again = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", "mod.py",
+         "--root", str(tmp_path), "--baseline", str(baseline),
+         "--prune-baseline"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+    )
+    assert "pruned 0 stale entries, kept 1" in again.stdout
+
+
+def test_cli_github_format():
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dklint",
+         os.path.join("tests", "lint_fixtures", "dk104_mesh_axes.py"),
+         "--no-baseline", "--root", REPO_ROOT, "--format", "github"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert out.returncode == 1
+    lines = [ln for ln in out.stdout.splitlines() if ln]
+    assert len(lines) == 3
+    for ln in lines:
+        assert ln.startswith("::warning file=tests/lint_fixtures/dk104_mesh_axes.py,line=")
+        assert "title=dklint DK104::" in ln
 
 
 def test_cli_json_format():

@@ -119,9 +119,9 @@ def test_kafka_producer_tcp_stream():
         sys.path.insert(0, os.path.join(repo, "examples"))
         from streaming_inference import tcp_batches
 
-        deadline = time.time() + 60
+        deadline = time.monotonic() + 60
         batches = None
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             try:
                 # Retry only the pre-connect phase: the producer accepts a
                 # single consumer, so a post-connect transport error must

@@ -1,11 +1,13 @@
 """Importing this package registers every shipped checker."""
 
 from tools.dklint.checkers import (  # noqa: F401 — registration side effects
+    collectives,
     donation,
     finiteness,
     host_sync,
     locks,
     mesh_axes,
     recompile,
+    traced_branch,
     wallclock,
 )

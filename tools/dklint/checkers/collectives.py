@@ -1,0 +1,293 @@
+"""DK108 — collectives that deadlock a multi-chip mesh.
+
+Two shapes, both invisible to single-host CPU tests and fatal on a real
+TPU slice:
+
+  * a collective inside a ``shard_map``/``pmap``/``vmap`` body whose
+    ``axis_name`` is **not among the axes that mapper (or any enclosing
+    mapper) binds** — at best an unbound-axis trace error, at worst (nested
+    meshes, ``check_vma=False``) a reduce over the wrong device group;
+
+  * ``lax.cond`` branches containing **different collectives** — under SPMD
+    every device must execute the same collective sequence, but ``cond``
+    evaluates per-shard, so devices taking different branches stop at
+    different collectives and the mesh deadlocks.
+
+Axis sets are resolved best-effort: literal ``axis_name=`` strings,
+module-level string constants, inline ``Mesh(devs, ("a", "b"))``
+constructions, and module-level ``mesh = Mesh(...)`` bindings.  A mapper
+whose axes cannot be resolved leaves its body *open* — nothing inside is
+flagged (trusted, same stance as DK104's unresolvable expressions).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+from tools.dklint.checkers.mesh_axes import AXIS_ARG_INDEX, COLLECTIVES
+
+MAPPERS = frozenset({
+    "jax.pmap", "pmap",
+    "jax.vmap", "vmap",
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+})
+
+COND_NAMES = frozenset({"lax.cond", "jax.lax.cond", "cond"})
+
+MESH_NAMES = frozenset({"Mesh", "jax.sharding.Mesh", "jax.make_mesh", "make_mesh"})
+
+
+def _resolve_strs(fi: FileInfo, expr: ast.AST) -> Optional[List[str]]:
+    """Axis-name strings an expression denotes, or None when unresolvable."""
+    if isinstance(expr, ast.Constant):
+        return [expr.value] if isinstance(expr.value, str) else None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in expr.elts:
+            got = _resolve_strs(fi, el)
+            if got is None:
+                return None
+            out.extend(got)
+        return out
+    if isinstance(expr, ast.Name) and expr.id in fi.str_constants:
+        return [fi.str_constants[expr.id]]
+    return None
+
+
+def _mesh_axes(fi: FileInfo, expr: ast.AST) -> Optional[List[str]]:
+    """Axis names of a mesh expression: inline ``Mesh(devs, names)`` /
+    ``axis_names=`` kwarg, or a Name bound at module level to one."""
+    if isinstance(expr, ast.Call) and call_name(expr) in MESH_NAMES:
+        for kw in expr.keywords:
+            if kw.arg in ("axis_names", "axis_name"):
+                return _resolve_strs(fi, kw.value)
+        if len(expr.args) >= 2:
+            return _resolve_strs(fi, expr.args[1])
+        return None
+    if isinstance(expr, ast.Name):
+        for node in fi.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == expr.id
+            ):
+                return _mesh_axes(fi, node.value)
+    return None
+
+
+def _mapper_axes(fi: FileInfo, call: ast.Call, short: str) -> Optional[Set[str]]:
+    """Axes a mapper call binds; None = unresolvable (body is open).
+    A vmap/pmap with no ``axis_name`` binds no named axis — empty set."""
+    if short == "shard_map":
+        for kw in call.keywords:
+            if kw.arg == "mesh":
+                axes = _mesh_axes(fi, kw.value)
+                return set(axes) if axes is not None else None
+        if len(call.args) >= 2:
+            axes = _mesh_axes(fi, call.args[1])
+            return set(axes) if axes is not None else None
+        return None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            axes = _resolve_strs(fi, kw.value)
+            return set(axes) if axes is not None else None
+    return set()
+
+
+def _collectives_in(fi: FileInfo, fn: ast.AST, skip: Set[int]) -> List[Tuple[ast.Call, str, Optional[List[str]]]]:
+    """(call node, short name, resolved axes or None) for every collective
+    in ``fn``'s subtree, skipping nodes in ``skip``."""
+    out = []
+    for node in ast.walk(fn):
+        if id(node) in skip or not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        if cname is None:
+            continue
+        short = cname.rsplit(".", 1)[-1]
+        if short not in COLLECTIVES:
+            continue
+        axis_expr = None
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                axis_expr = kw.value
+        if axis_expr is None:
+            idx = AXIS_ARG_INDEX[short]
+            if idx < len(node.args):
+                axis_expr = node.args[idx]
+        axes = _resolve_strs(fi, axis_expr) if axis_expr is not None else None
+        out.append((node, short, axes))
+    return out
+
+
+@register
+class CollectiveContextChecker(Checker):
+    rule = "DK108"
+    name = "collective-outside-mapped-axes"
+    description = (
+        "collective axis_name not bound by the enclosing shard_map/pmap/"
+        "vmap, or collectives differing between lax.cond branches — "
+        "multi-chip deadlock"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        defs: Dict[str, List[ast.AST]] = {}
+        parents: Dict[int, Optional[ast.AST]] = {}
+        stack: List[ast.AST] = []
+
+        def walk(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                if not isinstance(node, ast.Lambda):
+                    defs.setdefault(node.name, []).append(node)
+                parents[id(node)] = stack[-1] if stack else None
+                stack.append(node)
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+                stack.pop()
+            else:
+                if isinstance(node, ast.Call):
+                    parents[id(node)] = stack[-1] if stack else None
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+
+        walk(fi.tree)
+
+        # mapper call sites: body fn -> list of (mapper call, axes|None)
+        contexts: Dict[int, List[Tuple[ast.Call, Optional[Set[str]], str]]] = {}
+        body_nodes: Dict[int, ast.AST] = {}
+        mapper_calls: List[Tuple[ast.Call, str]] = []
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname not in MAPPERS:
+                continue
+            short = cname.rsplit(".", 1)[-1]
+            mapper_calls.append((node, short))
+            axes = _mapper_axes(fi, node, short)
+            if not node.args:
+                continue
+            body = node.args[0]
+            bodies: List[ast.AST] = []
+            if isinstance(body, ast.Lambda):
+                bodies = [body]
+            elif isinstance(body, ast.Name):
+                bodies = defs.get(body.id, [])
+            for b in bodies:
+                contexts.setdefault(id(b), []).append((node, axes, short))
+                body_nodes[id(b)] = b
+
+        # effective axes of a body = union over every wrapping mapper of
+        # (that mapper's axes + the effective axes of the function the
+        # mapper call lexically sits in); None anywhere -> open
+        memo: Dict[int, Optional[Set[str]]] = {}
+
+        def effective_fn(fn: ast.AST, seen: Set[int]) -> Optional[Set[str]]:
+            if id(fn) in memo:
+                return memo[id(fn)]
+            if id(fn) in seen:
+                return set()
+            seen = seen | {id(fn)}
+            if id(fn) not in contexts:
+                # not a mapped body itself: inherit from the lexically
+                # enclosing function, if any
+                parent = parents.get(id(fn))
+                result = effective_fn(parent, seen) if parent is not None else set()
+            else:
+                result: Optional[Set[str]] = set()
+                for call, axes, _short in contexts[id(fn)]:
+                    if axes is None:
+                        result = None
+                        break
+                    enclosing = parents.get(id(call))
+                    outer = effective_fn(enclosing, seen) if enclosing is not None else set()
+                    if outer is None:
+                        result = None
+                        break
+                    result |= axes | outer
+            memo[id(fn)] = result
+            return result
+
+        for b_id, b in body_nodes.items():
+            axes = effective_fn(b, set())
+            if axes is None:
+                continue  # unresolvable mapper — trusted
+            # nested mapper bodies get their own (unioned) context — skip
+            # their subtrees so they are checked exactly once
+            local_skip: Set[int] = set()
+            for node in ast.walk(b):
+                if node is not b and id(node) in body_nodes:
+                    local_skip.update(id(n) for n in ast.walk(node))
+            for call, short, caxes in _collectives_in(fi, b, local_skip):
+                if caxes is None:
+                    continue  # unresolvable axis expression — trusted
+                for ax in caxes:
+                    if ax not in axes:
+                        yield Finding(
+                            path=fi.relpath,
+                            line=call.lineno,
+                            col=call.col_offset,
+                            rule=self.rule,
+                            message=(
+                                f"{short} over axis '{ax}' inside a mapped "
+                                "body that only binds "
+                                f"{sorted(axes) or 'no named axes'} — unbound "
+                                "axis at trace time, or a wrong-group "
+                                "reduction on a nested mesh"
+                            ),
+                        )
+
+        yield from self._check_cond_branches(fi, defs)
+
+    # -- lax.cond branch divergence -----------------------------------------
+    def _check_cond_branches(
+        self, fi: FileInfo, defs: Dict[str, List[ast.AST]]
+    ) -> Iterable[Finding]:
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call) or call_name(node) not in COND_NAMES:
+                continue
+            if len(node.args) < 3:
+                continue
+            branches = []
+            for arg in node.args[1:3]:
+                if isinstance(arg, ast.Lambda):
+                    branches.append(arg)
+                elif isinstance(arg, ast.Name) and len(defs.get(arg.id, [])) == 1:
+                    branches.append(defs[arg.id][0])
+                else:
+                    branches.append(None)
+            if any(b is None for b in branches):
+                continue  # unresolvable branch — trusted
+
+            def signature(fn: ast.AST) -> Counter:
+                sig: Counter = Counter()
+                for _call, short, axes in _collectives_in(fi, fn, set()):
+                    key = (short, tuple(sorted(axes)) if axes is not None else None)
+                    sig[key] += 1
+                return sig
+
+            true_sig, false_sig = signature(branches[0]), signature(branches[1])
+            if true_sig != false_sig and (true_sig or false_sig):
+                def fmt(sig: Counter) -> str:
+                    if not sig:
+                        return "none"
+                    return ", ".join(
+                        f"{name}({'/'.join(axes) if axes else '?'})" + (f" x{n}" if n > 1 else "")
+                        for (name, axes), n in sorted(sig.items())
+                    )
+                yield Finding(
+                    path=fi.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.rule,
+                    message=(
+                        "lax.cond branches run different collectives "
+                        f"(true: {fmt(true_sig)}; false: {fmt(false_sig)}) — "
+                        "devices taking different branches deadlock the mesh"
+                    ),
+                )

@@ -16,6 +16,11 @@ destroying the async-dispatch pipelining the windowed engines depend on.
     though the ``jax.jit`` call happens a method away);
   * anything those functions call by local name (``self._helper(...)`` or
     ``_helper(...)``), propagated to a fixpoint within the module;
+  * anything those functions call **across modules** — through a
+    ``from utils.pytree import tree_norm`` binding or a ``pt.tree_norm(...)``
+    module-attribute call — resolved over the whole analyzed tree via each
+    file's import map, to the same fixpoint (a host sync hiding in a helper
+    module called from a hot engine body is still a per-step sync);
   * every ``def``/``lambda`` nested inside a hot function.
 
 ``float``/``int`` casts are only flagged when applied to a *parameter of the
@@ -151,9 +156,11 @@ def _function_args_passed_to_tracers(tree: ast.Module) -> Set[str]:
 
 
 def _local_calls(fn: ast.AST) -> Set[str]:
-    """Names this function calls as ``name(...)`` or ``self.name(...)``,
-    excluding calls that happen inside nested defs (those are their own
-    functions)."""
+    """Call targets of this function (excluding nested defs' bodies):
+    ``name(...)`` and ``self.name(...)`` yield the bare name (resolved
+    against local defs and the import map); any other dotted call whose base
+    is a plain name chain (``pt.tree_norm(...)``) yields the dotted string
+    for cross-module resolution."""
     out: Set[str] = set()
     nested: Set[int] = set()
     for child in ast.walk(fn):
@@ -174,6 +181,10 @@ def _local_calls(fn: ast.AST) -> Set[str]:
             and node.func.value.id == "self"
         ):
             out.add(node.func.attr)
+        elif isinstance(node.func, ast.Attribute):
+            dotted = dotted_name(node.func)
+            if dotted:
+                out.add(dotted)
     return out
 
 
@@ -219,6 +230,111 @@ def hot_functions(tree: ast.Module) -> Set[int]:
     return hot
 
 
+# --------------------------------------------------- interprocedural (v2)
+
+FACTS_KEY = "DK101.facts"
+HOT_KEY = "DK101.hot"
+
+
+def _file_facts(fi: FileInfo) -> dict:
+    index = _FnIndex()
+    index.visit(fi.tree)
+    return {
+        "fi": fi,
+        "index": index,
+        "traced": _function_args_passed_to_tracers(fi.tree),
+        "calls": {id(fn): _local_calls(fn) for fn in index.fns},
+    }
+
+
+def _seed_hot(facts: dict) -> Set[int]:
+    """Per-file hot seeds: jit-decorated, passed to a tracing wrapper by
+    name, or an engine hot method."""
+    index, traced = facts["index"], facts["traced"]
+    hot: Set[int] = set()
+    for fn in index.fns:
+        name = getattr(fn, "name", "<lambda>")
+        if _decorator_jits(fn):
+            hot.add(id(fn))
+        elif name in traced:
+            hot.add(id(fn))
+        elif id(fn) in index.in_engine_class and name in ENGINE_HOT_METHODS:
+            hot.add(id(fn))
+    return hot
+
+
+def _modules_match(target_mod: str, analyzed_mod: str) -> bool:
+    """True when a dotted import target plausibly denotes an analyzed file.
+    Suffix-tolerant both ways because the import was written against
+    ``sys.path`` while the analyzed module name is root-relative."""
+    if not target_mod or not analyzed_mod:
+        return False
+    return (
+        target_mod == analyzed_mod
+        or analyzed_mod.endswith("." + target_mod)
+        or target_mod.endswith("." + analyzed_mod)
+    )
+
+
+def global_hot_functions(project: Project) -> Set[int]:
+    """ids of hot function nodes across every analyzed file, with hotness
+    propagated through cross-module calls (memoized per run)."""
+    cached = project.data.get(HOT_KEY)
+    if cached is not None:
+        return cached
+    all_facts: Dict[str, dict] = project.data.get(FACTS_KEY, {})
+
+    hot: Set[int] = set()
+    for facts in all_facts.values():
+        hot |= _seed_hot(facts)
+
+    # module-level named defs across the tree, for cross-module resolution
+    toplevel: Dict[str, List[Tuple[str, ast.AST]]] = {}
+    for facts in all_facts.values():
+        index = facts["index"]
+        for fn in index.fns:
+            if index.parents.get(id(fn)) is None and not isinstance(fn, ast.Lambda):
+                toplevel.setdefault(fn.name, []).append((facts["fi"].module, fn))
+
+    def external(target: str) -> List[ast.AST]:
+        mod, _, name = target.rpartition(".")
+        return [
+            fn for m, fn in toplevel.get(name, []) if _modules_match(mod, m)
+        ]
+
+    changed = True
+    while changed:
+        changed = False
+        for facts in all_facts.values():
+            fi, index = facts["fi"], facts["index"]
+            for fn in index.fns:
+                if id(fn) not in hot:
+                    continue
+                for target in facts["calls"][id(fn)]:
+                    callees: List[ast.AST] = []
+                    if "." not in target:
+                        callees.extend(index.by_name.get(target, []))
+                        if target in fi.imports:
+                            callees.extend(external(fi.imports[target]))
+                    else:
+                        head, rest = target.split(".", 1)
+                        if head in fi.imports:
+                            callees.extend(external(fi.imports[head] + "." + rest))
+                    for callee in callees:
+                        if id(callee) not in hot:
+                            hot.add(id(callee))
+                            changed = True
+            # defs nested inside a hot function are hot
+            for fn in index.fns:
+                parent = index.parents.get(id(fn))
+                if parent in hot and id(fn) not in hot:
+                    hot.add(id(fn))
+                    changed = True
+
+    project.data[HOT_KEY] = hot
+    return hot
+
+
 def _own_params(fn: ast.AST) -> Set[str]:
     args = fn.args
     names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
@@ -238,8 +354,11 @@ class HostSyncChecker(Checker):
         "block_until_ready) inside a jitted or engine-step-loop function"
     )
 
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        project.data.setdefault(FACTS_KEY, {})[fi.relpath] = _file_facts(fi)
+
     def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
-        hot = hot_functions(fi.tree)
+        hot = global_hot_functions(project)
         findings: List[Finding] = []
         for fn in ast.walk(fi.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):

@@ -1,0 +1,172 @@
+"""DK109 — Python control flow on a traced parameter of a hot function.
+
+``if x > 0:`` inside a function handed to ``jax.jit``/``vmap``/``lax.scan``
+by name does not branch at runtime — it crashes at *trace* time with a
+``TracerBoolConversionError`` the first time the wrapper is called, which in
+the windowed engines is deep inside ``run_epoch`` where the traceback no
+longer points at the offending line.  DK102 already covers the
+``@jax.jit``-decorated form; this rule covers the other way functions go
+hot — being **passed by name** to a tracing wrapper — where the decoration
+site and the def can be screens apart.
+
+Exemptions (all trace-time static, hence legal Python control flow):
+
+  * ``x is None`` / ``x is not None`` (pytree-structure dispatch);
+  * ``isinstance(x, ...)``;
+  * ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` and ``len(x)``;
+  * parameters named in ``static_argnums``/``static_argnames`` at the
+    ``jax.jit`` call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+from tools.dklint.checkers.host_sync import TRACING_WRAPPERS
+from tools.dklint.checkers.recompile import _jit_decorated
+
+# attribute reads on a traced array that are static at trace time
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+
+
+def _static_at_callsite(call: ast.Call, fn: ast.AST) -> Set[str]:
+    """Parameter names of ``fn`` made static by this wrapper call's
+    ``static_argnums``/``static_argnames``."""
+    static: Set[str] = set()
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    static.add(el.value)
+        elif kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    if 0 <= el.value < len(pos):
+                        static.add(pos[el.value])
+    return static
+
+
+def _traced_uses(test: ast.AST, params: Set[str]) -> List[ast.Name]:
+    """Name nodes in a test expression that force bool() on a traced value.
+
+    Walks manually so statically-evaluable forms (``is None``,
+    ``isinstance``, ``.shape``-family attributes, ``len()``) skip their
+    traced operand instead of flagging it."""
+    out: List[ast.Name] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Name):
+            if node.id in params:
+                out.append(node)
+            return
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return  # x.shape and friends are trace-time constants
+            visit(node.value)
+            return
+        if isinstance(node, ast.Call):
+            cname = call_name(node)
+            if cname in ("isinstance", "len"):
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            return
+        if isinstance(node, ast.Compare):
+            # ``x is None`` / ``x is not None`` never materialises x
+            none_ops = all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            )
+            if none_ops and any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators
+            ):
+                return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return out
+
+
+@register
+class TracedBranchChecker(Checker):
+    rule = "DK109"
+    name = "python-branch-on-traced-param"
+    description = (
+        "Python if/while on a traced parameter of a function passed by "
+        "name to jax.jit/vmap/shard_map/lax.scan — TracerBoolConversionError "
+        "at trace time"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        # defs by name at any nesting level, for call-site resolution
+        defs: dict = {}
+        for node in ast.walk(fi.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        # fn node id -> intersection of static names over every tracing
+        # call site that references it (a param is only safe when *every*
+        # wrapping marks it static)
+        static_by_fn: dict = {}
+        wrapped: dict = {}
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname not in TRACING_WRAPPERS:
+                continue
+            for arg in node.args:
+                if not isinstance(arg, ast.Name):
+                    continue
+                for fn in defs.get(arg.id, []):
+                    wrapped.setdefault(id(fn), (fn, cname))
+                    statics = _static_at_callsite(node, fn)
+                    if id(fn) in static_by_fn:
+                        static_by_fn[id(fn)] &= statics
+                    else:
+                        static_by_fn[id(fn)] = statics
+
+        for fn_id, (fn, wrapper) in wrapped.items():
+            # @jax.jit-decorated defs are DK102's territory
+            if _jit_decorated(fn):
+                continue
+            yield from self._check_fn(fi, fn, wrapper, static_by_fn.get(fn_id, set()))
+
+    def _check_fn(
+        self, fi: FileInfo, fn: ast.AST, wrapper: str, static: Set[str]
+    ) -> Iterable[Finding]:
+        params = {
+            a.arg
+            for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+            if a.arg not in ("self", "cls")
+        } - static
+        nested: Set[int] = set()
+        for child in ast.walk(fn):
+            if child is not fn and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                nested.update(id(s) for s in ast.walk(child))
+        for node in ast.walk(fn):
+            if id(node) in nested:
+                continue
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            kind = "if" if isinstance(node, ast.If) else "while"
+            for use in _traced_uses(node.test, params):
+                yield Finding(
+                    path=fi.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.rule,
+                    message=(
+                        f"Python `{kind}` on traced parameter '{use.id}' of "
+                        f"'{getattr(fn, 'name', '<fn>')}' (traced via "
+                        f"{wrapper}): crashes at trace time — use "
+                        "lax.cond/jnp.where, or mark the argument static"
+                    ),
+                )

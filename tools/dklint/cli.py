@@ -37,7 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ignore any baseline file")
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings to the baseline file and exit 0")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="drop baseline entries that no longer match any "
+                        "finding (keeps reasons on the survivors) and exit 0")
+    p.add_argument("--format", choices=("text", "json", "github"), default="text",
+                   help="github emits ::warning workflow annotations")
     p.add_argument("--list-rules", action="store_true",
                    help="list registered rules and exit")
     return p
@@ -68,6 +72,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"dklint: wrote {len(findings)} finding(s) to {baseline_path}")
         return 0
 
+    if args.prune_baseline:
+        if not os.path.exists(baseline_path):
+            print(f"dklint: no baseline at {baseline_path}", file=sys.stderr)
+            return 2
+        entries = core.load_baseline(baseline_path)
+        _new, stale = core.apply_baseline(findings, entries, files)
+        stale_ids = {id(e) for e in stale}
+        kept = [e for e in entries if id(e) not in stale_ids]
+        core.write_baseline_entries(baseline_path, kept)
+        print(
+            f"dklint: pruned {len(stale)} stale entr"
+            f"{'y' if len(stale) == 1 else 'ies'}, kept {len(kept)}"
+        )
+        return 0
+
     stale: List[dict] = []
     if not args.no_baseline and os.path.exists(baseline_path):
         entries = core.load_baseline(baseline_path)
@@ -75,6 +94,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.format == "json":
         print(json.dumps([f.__dict__ for f in findings], indent=2))
+    elif args.format == "github":
+        # GitHub Actions workflow-command annotations: one ::warning per
+        # finding, surfaced inline on the PR diff
+        for f in findings:
+            message = f"{f.rule} {f.message}".replace("%", "%25").replace(
+                "\r", "%0D").replace("\n", "%0A")
+            print(
+                f"::warning file={f.path},line={f.line},col={f.col + 1},"
+                f"title=dklint {f.rule}::{message}"
+            )
+        if findings:
+            print(f"dklint: {len(findings)} unbaselined finding(s)", file=sys.stderr)
     else:
         for f in findings:
             print(f.render())

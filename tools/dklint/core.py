@@ -11,7 +11,8 @@ The analyzer is a two-pass AST walk over a set of Python files:
 Findings are filtered through two suppression layers:
 
   * ``# dklint: disable=DK101[,DK102...]`` as a *trailing* comment on a code
-    line suppresses those rules for that line;
+    line suppresses those rules for that line; on a decorator line it covers
+    the whole decorated function (see :func:`extend_decorator_suppressions`);
   * the same directive on a *standalone* comment line suppresses the rules
     for the whole file (the per-file form ISSUE.md specifies);
   * a committed baseline file grandfathers findings by
@@ -61,6 +62,13 @@ class FileInfo:
     file_disabled: Set[str] = field(default_factory=set)
     # module-level ``NAME = "literal"`` string constants (DK104 resolution)
     str_constants: Dict[str, str] = field(default_factory=dict)
+    # dotted module name derived from relpath ("distkeras_tpu.utils.pytree");
+    # the interprocedural pass keys its cross-module call graph on this
+    module: str = ""
+    # local binding -> dotted target: ``import numpy as np`` -> {"np":
+    # "numpy"}; ``from a.b import f as g`` -> {"g": "a.b.f"}; relative
+    # imports resolved against ``module``
+    imports: Dict[str, str] = field(default_factory=dict)
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -124,6 +132,27 @@ def scan_suppressions(fi: FileInfo) -> None:
         pass
 
 
+def extend_decorator_suppressions(fi: FileInfo) -> None:
+    """A trailing directive on a *decorator* line suppresses those rules for
+    the whole decorated function/class — the decorator is the reason the body
+    trips the rule (e.g. ``@jax.jit  # dklint: disable=DK101`` makes every
+    line of the body hot), so pinning the directive to the one line the
+    author can see it on must cover the findings it provokes below."""
+    for node in ast.walk(fi.tree):
+        decorators = getattr(node, "decorator_list", None)
+        if not decorators:
+            continue
+        rules: Set[str] = set()
+        for dec in decorators:
+            for line in range(dec.lineno, (dec.end_lineno or dec.lineno) + 1):
+                rules |= fi.line_disabled.get(line, set())
+        if not rules:
+            continue
+        end = getattr(node, "end_lineno", None) or node.lineno
+        for line in range(node.lineno, end + 1):
+            fi.line_disabled.setdefault(line, set()).update(rules)
+
+
 def is_suppressed(fi: FileInfo, finding: Finding) -> bool:
     if "ALL" in fi.file_disabled or finding.rule in fi.file_disabled:
         return True
@@ -155,7 +184,11 @@ def save_baseline(path: str, findings: Sequence[Finding], files: Dict[str, FileI
         }
         for f in findings
     ]
-    doc = {"version": 1, "findings": entries}
+    write_baseline_entries(path, entries)
+
+
+def write_baseline_entries(path: str, entries: Sequence[dict]) -> None:
+    doc = {"version": 1, "findings": list(entries)}
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")
@@ -224,6 +257,47 @@ def _collect_str_constants(tree: ast.Module) -> Dict[str, str]:
     return consts
 
 
+def module_name(relpath: str) -> str:
+    """Dotted module name for a root-relative path; ``pkg/__init__.py`` is
+    the package itself.  Files outside the root (``../x.py``) degrade to
+    their basename so the call graph still has a usable key."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else relpath.split("/")
+    parts = [p for p in parts if p != ".."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _collect_imports(tree: ast.Module, module: str, is_package: bool) -> Dict[str, str]:
+    """Map every local binding an import introduces to its dotted target."""
+    imports: Dict[str, str] = {}
+    # the anchor package relative imports resolve against
+    pkg_parts = module.split(".") if module else []
+    if not is_package and pkg_parts:
+        pkg_parts = pkg_parts[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    imports[alias.asname] = alias.name
+                else:
+                    # ``import a.b.c`` binds only the top-level name ``a``
+                    top = alias.name.split(".")[0]
+                    imports[top] = top
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                anchor = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = anchor + (node.module.split(".") if node.module else [])
+            else:
+                base = node.module.split(".") if node.module else []
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name
+                imports[bound] = ".".join(base + [alias.name])
+    return imports
+
+
 def load_file(abspath: str, root: str) -> FileInfo:
     with open(abspath, "r", encoding="utf-8") as f:
         source = f.read()
@@ -237,7 +311,10 @@ def load_file(abspath: str, root: str) -> FileInfo:
         lines=source.splitlines(),
     )
     fi.str_constants = _collect_str_constants(tree)
+    fi.module = module_name(rel)
+    fi.imports = _collect_imports(tree, fi.module, os.path.basename(abspath) == "__init__.py")
     scan_suppressions(fi)
+    extend_decorator_suppressions(fi)
     return fi
 
 
