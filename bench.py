@@ -409,6 +409,7 @@ def preflight(max_tries: int = 6, init_timeout: float = 120.0, retry_sleep: floa
         ok, last = _probe_subprocess(init_timeout)
         if ok:
             break
+        _note_init_failure()
         transient = (
             "UNAVAILABLE" in last or "Unable to initialize" in last
             or "timed out" in last
@@ -436,9 +437,23 @@ def preflight(max_tries: int = 6, init_timeout: float = 120.0, retry_sleep: floa
     t.join(init_timeout)
     if "n" in result:
         return result
+    _note_init_failure()
     if t.is_alive():
         return {"error": f"in-process init hung {init_timeout:.0f}s after a live probe"}
     return {"error": result.get("error", "backend init failed without an exception")}
+
+
+def _note_init_failure():
+    """Tally one failed backend-availability probe/init in the metrics
+    registry — the count rides the emitted metrics JSONL and the live
+    scrape, so a fallback run shows HOW flaky the backend was, not just
+    that it fell over."""
+    from distkeras_tpu.telemetry import metrics as registry
+
+    registry.counter(
+        "bench_backend_init_failures",
+        help="failed backend probes/inits before a bench run (or fallback)",
+    ).inc()
 
 
 # Set from jax.process_index() right after jax.distributed.initialize in
@@ -466,6 +481,43 @@ def _emit_error(message: str, metric: str = HEADLINE_METRIC):
         "status": "error",
         "error": message,
     }))
+
+
+def ensure_backend(pending):
+    """Preflight with CPU fallback: the single-process bench entry gate.
+
+    Runs the full retrying :func:`preflight`; when the configured backend is
+    unreachable — including the retries-exhausted/timeout branch — falls
+    back to a ``JAX_PLATFORMS=cpu`` mesh so the sweep still produces a
+    phase-annotated CPU smoke record (``platform: "cpu"``,
+    ``platform_fallback: <why>``) instead of an all-error trajectory.
+    Returns the backend dict on success; ``None`` when even the CPU fallback
+    failed, with an error line already emitted for every ``pending`` metric.
+    """
+    backend = preflight()
+    if "error" not in backend:
+        return backend
+    global _PLATFORM_FALLBACK
+    _PLATFORM_FALLBACK = backend["error"]
+    import sys
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "jax" in sys.modules:
+        # preflight's in-process probe may have imported jax already;
+        # the config knob reaches a live module where env cannot
+        try:
+            sys.modules["jax"].config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — fallback probe decides below
+            pass
+    backend = preflight(max_tries=1)
+    if "error" in backend:
+        for m in pending:
+            _emit_error(
+                "backend unavailable after retries and the CPU "
+                f"fallback also failed: {backend['error']}",
+                metric=m)
+        return None
+    return backend
 
 
 def _ok_line(result: dict) -> str:
@@ -1209,32 +1261,8 @@ def main():
         pending.extend(f"{c}_mfu_ceiling" for c in configs)
 
     if not args.distributed and not args.cpu:
-        backend = preflight()
-        if "error" in backend:
-            # Fall back to a CPU mesh instead of emitting error verdicts: a
-            # phase-annotated CPU smoke record (platform: "cpu",
-            # platform_fallback: <why>) ends the all-error bench trajectory
-            # and still exercises the full measurement path.
-            global _PLATFORM_FALLBACK
-            _PLATFORM_FALLBACK = backend["error"]
-            import sys
-
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            if "jax" in sys.modules:
-                # preflight's in-process probe may have imported jax already;
-                # the config knob reaches a live module where env cannot
-                try:
-                    sys.modules["jax"].config.update("jax_platforms", "cpu")
-                except Exception:  # noqa: BLE001 — fallback probe decides below
-                    pass
-            backend = preflight(max_tries=1)
-            if "error" in backend:
-                for m in pending:
-                    _emit_error(
-                        "backend unavailable after retries and the CPU "
-                        f"fallback also failed: {backend['error']}",
-                        metric=m)
-                return
+        if ensure_backend(pending) is None:
+            return
 
     import jax
 

@@ -95,6 +95,16 @@ class PunchcardServer:
         self._sock.listen(16)
         with self._cv:
             self._running = True
+        if telemetry.enabled():
+            # Fleet correlation + live scrape: mint the daemon's run_id now
+            # (spawned jobs inherit it through their env) and start the HTTP
+            # exporter when one is configured, with the fleet-merged
+            # /aggregate view mounted next to the per-process endpoints.
+            telemetry.flightdeck.activate()
+            telemetry.flightdeck.add_endpoint(
+                "/aggregate",
+                lambda: ("application/json", json.dumps(self._fleet_snapshot())),
+            )
         for target in (self._accept_loop, self._runner_loop):
             t = threading.Thread(target=target, daemon=True)
             t.start()
@@ -152,8 +162,14 @@ class PunchcardServer:
                 if job is None:
                     send_data(conn, {"status": "unknown"})
                 else:
+                    # telemetry_dir / http / last_heartbeat let an operator
+                    # find (and scrape) a wedged job without grepping the
+                    # daemon log; all None while telemetry is off.
                     send_data(conn, {"status": job["status"], "output": job["output"],
-                                     "returncode": job["returncode"]})
+                                     "returncode": job["returncode"],
+                                     "telemetry_dir": job.get("telemetry_dir"),
+                                     "http": self._job_http_address(job),
+                                     "last_heartbeat": self._job_heartbeat(job)})
             elif action == "list":
                 send_data(conn, {"status": "ok",
                                  "jobs": {k: v["status"] for k, v in self.jobs.items()}})
@@ -162,17 +178,28 @@ class PunchcardServer:
                 # Prometheus text (for scrapers / humans) plus the structured
                 # snapshot, both JSON-safe for the restricted codec — and the
                 # merged whole-fleet view of every job that reported metrics.
-                send_data(conn, {"status": "ok",
-                                 "enabled": telemetry.enabled(),
-                                 "prometheus": telemetry.metrics.to_prometheus(),
-                                 "snapshot": telemetry.metrics.snapshot(),
-                                 "fleet": self._fleet_snapshot()})
+                reply = {"status": "ok",
+                         "enabled": telemetry.enabled(),
+                         "prometheus": telemetry.metrics.to_prometheus(),
+                         "snapshot": telemetry.metrics.snapshot(),
+                         "fleet": self._fleet_snapshot()}
+                job = self.jobs.get(msg.get("job_id") or "")
+                if job is not None:
+                    # live scrape of a still-running job's /vars through its
+                    # flightdeck exporter, instead of waiting for job exit
+                    reply["live"] = self._job_live_vars(job)
+                send_data(conn, reply)
             elif action == "aggregate":
                 send_data(conn, {"status": "ok", **self._fleet_snapshot()})
             else:
                 send_data(conn, {"status": "bad_request"})
         except (ConnectionError, ValueError, OSError):
             pass
+        except Exception:
+            # a handler crash on a daemon thread would otherwise vanish with
+            # the connection — leave the blackbox behind, then let it surface
+            telemetry.flightdeck.on_crash("punchcard._handle crashed")
+            raise
         finally:
             conn.close()
 
@@ -198,33 +225,122 @@ class PunchcardServer:
                 # clobbering each other's files
                 tel_dir = os.path.join(self.workdir, "telemetry", job_id)
                 os.makedirs(tel_dir, exist_ok=True)
+                job["telemetry_dir"] = tel_dir
+                # the fleet run_id rides the env so every job stamps its
+                # trace events with the daemon's id (dktrace merge joins on
+                # it); when the daemon itself is scrape-able, jobs get an
+                # ephemeral exporter too, advertised via their discovery file
                 env = dict(os.environ, DISTKERAS_TELEMETRY="1",
-                           DISTKERAS_TELEMETRY_DIR=tel_dir)
+                           DISTKERAS_TELEMETRY_DIR=tel_dir,
+                           DISTKERAS_RUN_ID=telemetry.flightdeck.run_id())
+                if telemetry.flightdeck.http_port() is not None:
+                    env["DISTKERAS_TELEMETRY_HTTP"] = "0"
             try:
-                proc = subprocess.run(
-                    [sys.executable, script_path, *map(str, job["args"])],
-                    capture_output=True, text=True, timeout=3600, cwd=self.workdir,
-                    env=env,
-                )
+                # the job_run span is dktrace merge's clock-skew anchor: a
+                # job's own trace starts at its process-local perf origin,
+                # and realigning it into the fleet timeline needs the
+                # daemon-side dispatch window
+                with telemetry.trace.span("job_run", job_id=job_id):
+                    proc = subprocess.run(
+                        [sys.executable, script_path, *map(str, job["args"])],
+                        capture_output=True, text=True, timeout=3600, cwd=self.workdir,
+                        env=env,
+                    )
                 job["output"] = proc.stdout + proc.stderr
                 job["returncode"] = proc.returncode
                 outcome = "finished" if proc.returncode == 0 else "failed"
             except subprocess.TimeoutExpired:
                 outcome = "timeout"
             if tel_dir is not None:
-                job["metrics"] = _collect_job_snapshot(tel_dir)
+                with telemetry.trace.span("job_collect", job_id=job_id):
+                    job["metrics"] = _collect_job_snapshot(tel_dir)
             if telemetry.enabled():
                 telemetry.metrics.counter(
                     "punchcard_jobs_finished_total" if outcome == "finished"
                     else "punchcard_jobs_failed_total",
                     help="jobs the runner completed, by outcome",
                 ).inc()
+                if outcome != "finished":
+                    # daemon-side blackbox for the crashed/wedged job: the
+                    # ring holds its dispatch/collect spans and the fleet
+                    # counters at failure time
+                    telemetry.flightdeck.on_crash(
+                        f"punchcard job {job_id} {outcome}",
+                        extra={"job_id": job_id,
+                               "returncode": job["returncode"],
+                               "telemetry_dir": tel_dir})
                 # flush per job: fleet runs must not lose telemetry that
                 # would otherwise only be written at interpreter exit
                 telemetry.flush()
             # status last: clients poll it as the completion signal, so the
             # job's fleet snapshot must already be in place when it flips
             job["status"] = outcome
+
+    def _job_http_address(self, job: dict) -> Optional[str]:
+        """The job's live flightdeck address, from the discovery file its
+        exporter drops into the job telemetry dir.  Cached into the job map
+        once resolved; ``None`` while flightdeck is off or the job has not
+        come up yet."""
+        addr = job.get("http")
+        if addr:
+            return addr
+        tel_dir = job.get("telemetry_dir")
+        if not tel_dir:
+            return None
+        for path in sorted(glob.glob(os.path.join(tel_dir, "flightdeck_*.json"))):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    addr = json.load(fh).get("address")
+            except (OSError, ValueError):
+                continue
+            if addr:
+                job["http"] = addr
+                return addr
+        return None
+
+    def _job_heartbeat(self, job: dict) -> Optional[float]:
+        """Unix timestamp of the job's last observable activity: the live
+        ``/healthz`` answer when its exporter is up, else the newest mtime
+        in its telemetry dir, else ``None`` — how an operator spots a wedged
+        job from the ``status`` verb alone."""
+        addr = self._job_http_address(job)
+        if addr:
+            try:
+                import urllib.request
+
+                with urllib.request.urlopen(f"http://{addr}/healthz",
+                                            timeout=1.0) as resp:
+                    body = json.loads(resp.read().decode("utf-8"))
+                hb = body.get("last_event_unix") or body.get("unix")
+                if hb is not None:
+                    return float(hb)
+            except (OSError, ValueError):
+                pass
+        tel_dir = job.get("telemetry_dir")
+        if tel_dir and os.path.isdir(tel_dir):
+            try:
+                mtimes = [os.path.getmtime(os.path.join(tel_dir, name))
+                          for name in os.listdir(tel_dir)]
+            except OSError:
+                mtimes = []
+            if mtimes:
+                return max(mtimes)
+        return None
+
+    def _job_live_vars(self, job: dict) -> Optional[dict]:
+        """Scrape a still-running job's ``/vars`` (live metrics snapshot +
+        dynamics summary); ``None`` when the job has no live exporter."""
+        addr = self._job_http_address(job)
+        if not addr:
+            return None
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(f"http://{addr}/vars",
+                                        timeout=1.0) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except (OSError, ValueError):
+            return None
 
     def _fleet_snapshot(self) -> dict:
         """Merged metric snapshot across every job that reported metrics —
@@ -274,13 +390,20 @@ class Job:
             raise RuntimeError("job not submitted")
         return self._rpc({"action": "status", "job_id": self.job_id})
 
-    def metrics(self) -> dict:
+    def metrics(self, job_id: Optional[str] = None) -> dict:
         """Scrape the daemon's telemetry registry (``metrics`` verb):
         ``{"status": "ok", "enabled": ..., "prometheus": <text>,
         "snapshot": {...}, "fleet": {"jobs": N, "snapshot": <merged>,
         "prometheus": <text>}}`` — ``fleet`` is the whole-fleet merge of
-        every finished job's metric snapshot."""
-        return self._rpc({"action": "metrics"})
+        every finished job's metric snapshot.  With a ``job_id`` (defaults
+        to this client's submitted job) the reply also carries ``live``:
+        that job's ``/vars`` scraped through its flightdeck exporter while
+        it is still running (``None`` when flightdeck is off)."""
+        msg: dict = {"action": "metrics"}
+        jid = job_id or self.job_id
+        if jid:
+            msg["job_id"] = jid
+        return self._rpc(msg)
 
     def aggregate(self) -> dict:
         """Fleet-wide metric merge only (``aggregate`` verb): counters

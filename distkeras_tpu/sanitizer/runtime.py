@@ -97,11 +97,31 @@ def violations(kind=None) -> list:
     return out
 
 
+def _flightdeck_note(kind: str, message: str) -> None:
+    # Violations land in the flight-recorder ring (and, for strict mode, a
+    # blackbox dump) only when telemetry is on — with it off this is one
+    # cached-bool check, and report() itself only runs under an active guard.
+    from distkeras_tpu.telemetry import runtime as _tel_runtime
+
+    if not _tel_runtime.enabled():
+        return
+    from distkeras_tpu.telemetry.flightdeck.recorder import recorder as _rec
+
+    _rec.record_sanitizer(kind, message, strict())
+
+
 def report(kind: str, message: str, exc_type=SanitizerViolation) -> None:
     """Route one violation: raise in strict mode; in record mode bump the
     ``sanitizer_<kind>_violations`` counter, remember the message, and warn
     the first time each kind fires."""
+    _flightdeck_note(kind, message)
     if strict():
+        from distkeras_tpu.telemetry import runtime as _tel_runtime
+
+        if _tel_runtime.enabled():
+            from distkeras_tpu.telemetry.flightdeck.recorder import on_crash
+
+            on_crash(f"sanitizer strict violation [{kind}]: {message}")
         raise exc_type(message)
     # record mode — the counter lives in the telemetry registry so the
     # existing exporters (Prometheus / JSONL / fleet merge) pick it up; the

@@ -8,7 +8,10 @@ One subsystem, three surfaces:
   with Prometheus-text, JSONL, and ScalarLogger exporters, plus
   ``jax.monitoring`` compile hooks;
 * :mod:`.profiler` — step-windowed ``jax.profiler`` capture via
-  ``DISTKERAS_PROFILE=dir``.
+  ``DISTKERAS_PROFILE=dir``;
+* :mod:`.flightdeck` — live HTTP scrape (``DISTKERAS_TELEMETRY_HTTP``),
+  flight-recorder ring with crash blackbox dumps, and the fleet ``run_id``
+  stamped into every trace event and scrape.
 
 Everything is gated on ``DISTKERAS_TELEMETRY`` (see :mod:`.runtime`): with
 the flag unset, ``trace.span()`` returns a shared no-op and instrumented
@@ -20,7 +23,7 @@ from __future__ import annotations
 
 import os
 
-from distkeras_tpu.telemetry import dynamics, runtime
+from distkeras_tpu.telemetry import dynamics, flightdeck, runtime
 from distkeras_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -44,6 +47,7 @@ __all__ = [
     "configure",
     "dynamics",
     "enabled",
+    "flightdeck",
     "flush",
     "install_jax_hooks",
     "metrics",
@@ -62,8 +66,12 @@ def flush(directory=None):
     d = directory or out_dir()
     os.makedirs(d, exist_ok=True)
     pid = os.getpid()
+    extra = {"pid": pid}
+    rid = flightdeck.current_run_id()
+    if rid is not None:
+        extra["run_id"] = rid
     trace_path = trace.write(os.path.join(d, f"trace_{pid}.json"))
     metrics_path = metrics.write_jsonl(
-        os.path.join(d, f"metrics_{pid}.jsonl"), extra={"pid": pid}
+        os.path.join(d, f"metrics_{pid}.jsonl"), extra=extra
     )
     return trace_path, metrics_path

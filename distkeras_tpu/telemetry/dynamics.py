@@ -29,6 +29,7 @@ import json
 import math
 import os
 import threading
+import time
 import warnings
 from collections import deque
 from dataclasses import dataclass
@@ -36,6 +37,7 @@ from typing import Any, Dict, Optional
 
 from distkeras_tpu.telemetry import runtime as _runtime
 from distkeras_tpu.telemetry import metrics as _metrics_mod
+from distkeras_tpu.telemetry.flightdeck.recorder import recorder as _flight_recorder
 
 _FALSEY = ("", "0", "false", "no")
 
@@ -198,6 +200,17 @@ def summarize(dyn: Dict[str, Any], loss: Any = None) -> Dict[str, float]:
     return out
 
 
+# Most recent recorded epoch summary — the /vars scrape and blackbox dumps
+# read it so a crash report always carries the last known training health.
+_LAST_SUMMARY: Optional[Dict[str, Any]] = None
+
+
+def last_summary() -> Optional[Dict[str, Any]]:
+    """``{"epoch", "summary", "unix"}`` of the latest :func:`record` call,
+    or ``None`` before the first one (non-finite values stringified)."""
+    return _LAST_SUMMARY
+
+
 def record(epoch: int, dyn: Dict[str, Any], summary: Dict[str, float],
            directory: Optional[str] = None) -> None:
     """Publish one epoch of dynamics: gauges into the process registry and
@@ -205,6 +218,13 @@ def record(epoch: int, dyn: Dict[str, Any], summary: Dict[str, float],
     JSONL.  No-op when telemetry is disabled."""
     if not _runtime.enabled():
         return
+    global _LAST_SUMMARY
+    _LAST_SUMMARY = {
+        "epoch": int(epoch),
+        "summary": {k: (v if math.isfinite(v) else repr(v))
+                    for k, v in sorted(summary.items())},
+        "unix": time.time(),
+    }
     record_gauges(summary)
     append_series(epoch, dyn, summary, directory=directory)
 
@@ -346,6 +366,7 @@ class DivergenceWatchdog:
             div = summary.get("divergence_max")
             if div is not None and math.isfinite(div):
                 self._history.append(div)
+            self._note(epoch, "ok", None)
             return None
         self.trips += 1
         if _runtime.enabled():
@@ -353,12 +374,29 @@ class DivergenceWatchdog:
                 "dynamics_watchdog_trips_total",
                 help="divergence watchdog activations").inc()
         if self.policy == "warn":
+            self._note(epoch, "warn", reason)
             warnings.warn(f"divergence watchdog: {reason}", RuntimeWarning,
                           stacklevel=2)
             return "warn"
         if self.policy == "rollback" and self._rollbacks < self.max_rollbacks:
             self._pending = reason
+            self._note(epoch, "rollback", reason)
             return "rollback"
         suffix = ("" if self.policy == "halt"
                   else f" (rollback budget of {self.max_rollbacks} exhausted)")
+        self._note(epoch, "halt", reason + suffix)
         raise TrainingDiverged(reason + suffix)
+
+    def _note(self, epoch: int, action: str, reason: Optional[str]) -> None:
+        # Feed the flight-recorder ring so a blackbox dump shows the
+        # watchdog's view of the final epochs, not just the raised error.
+        if not _runtime.enabled():
+            return
+        _flight_recorder.record_watchdog({
+            "epoch": int(epoch),
+            "action": action,
+            "reason": reason,
+            "policy": self.policy,
+            "trips": self.trips,
+            "rollbacks": self._rollbacks,
+        })

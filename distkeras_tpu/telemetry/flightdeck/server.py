@@ -1,0 +1,241 @@
+"""Live HTTP exporter: scrape a running trainer/daemon instead of waiting.
+
+A stdlib :class:`http.server.ThreadingHTTPServer` on a daemon thread, gated
+by ``DISTKERAS_TELEMETRY_HTTP``:
+
+* unset / empty — off (the default; nothing binds, nothing serves);
+* ``<port>`` — serve on ``127.0.0.1:<port>``;
+* ``0`` — serve on an ephemeral port, discoverable in-process via
+  :func:`address` and across processes via the ``flightdeck_<pid>.json``
+  discovery file the server drops into the telemetry directory (how the
+  ``PunchcardServer`` finds its jobs' live ports).
+
+Endpoints:
+
+``/metrics``
+    Prometheus text from the process-global registry, every sample labelled
+    with the fleet ``run_id``.
+``/healthz``
+    Liveness: uptime, last event / last span-completion timestamps,
+    watchdog state, sanitizer mode and violation tallies.
+``/vars``
+    JSON: full metrics snapshot, phase breakdown, last dynamics summary.
+``/trace``
+    The flight-recorder ring as Chrome trace JSON (open in Perfetto).
+
+Handlers only *read* registry snapshots and the recorder ring (each guarded
+by its own cheap lock), so scraping never blocks the training loop.  The
+daemon adds its fleet ``/aggregate`` view through :func:`add_endpoint`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from distkeras_tpu.telemetry import runtime as _runtime
+from distkeras_tpu.telemetry.flightdeck import correlate
+from distkeras_tpu.telemetry.flightdeck.recorder import recorder as _flight_recorder
+
+__all__ = [
+    "add_endpoint",
+    "address",
+    "configure",
+    "ensure_server",
+    "http_port",
+    "stop",
+]
+
+_UNSET = object()
+
+# _UNSET = not yet resolved from the environment; None = off; int = port
+# (0 = ephemeral) once resolved or forced via configure().
+_PORT = _UNSET
+
+_SERVER: Optional[ThreadingHTTPServer] = None
+_THREAD: Optional[threading.Thread] = None
+_LOCK = threading.Lock()
+
+# Extra endpoint registry: path -> () -> (content_type, body).
+_EXTRA: Dict[str, Callable[[], Tuple[str, str]]] = {}
+
+
+def http_port() -> Optional[int]:
+    """Resolved exporter port (``0`` = ephemeral) or ``None`` when off.
+    Cached after the first environment read."""
+    global _PORT
+    if _PORT is _UNSET:
+        raw = os.environ.get("DISTKERAS_TELEMETRY_HTTP", "").strip()
+        if raw == "" or raw.lower() in ("off", "false", "no"):
+            _PORT = None
+        else:
+            _PORT = int(raw)
+    return _PORT
+
+
+def configure(port=_UNSET) -> None:
+    """Force the exporter port (int, ``0`` = ephemeral), turn it off
+    (``False``), or reset to env-driven (``None``, re-read lazily)."""
+    global _PORT
+    if port is None:
+        _PORT = _UNSET
+    elif port is False:
+        _PORT = None
+    else:
+        _PORT = int(port)
+
+
+def ensure_server() -> Optional[str]:
+    """Start the exporter once (idempotent) and return its address.
+
+    ``None`` when telemetry is disabled or no port is configured — callers
+    sprinkle this at entry points without checking anything first.
+    """
+    if not _runtime.enabled():
+        return None
+    port = http_port()
+    if port is None:
+        return None
+    global _SERVER, _THREAD
+    with _LOCK:
+        if _SERVER is None:
+            srv = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+            srv.daemon_threads = True
+            thread = threading.Thread(
+                target=srv.serve_forever, name="flightdeck-http", daemon=True
+            )
+            thread.start()
+            _SERVER, _THREAD = srv, thread
+            _write_discovery_file()
+    return address()
+
+
+def address() -> Optional[str]:
+    """``"127.0.0.1:<port>"`` of the live exporter, or ``None``."""
+    srv = _SERVER
+    if srv is None:
+        return None
+    host, port = srv.server_address[:2]
+    return f"{host}:{port}"
+
+
+def stop() -> None:
+    """Shut the exporter down (tests and daemon teardown)."""
+    global _SERVER, _THREAD
+    with _LOCK:
+        srv, _SERVER = _SERVER, None
+        thread, _THREAD = _THREAD, None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if thread is not None:
+        thread.join(timeout=5)
+
+
+def add_endpoint(path: str, fn: Callable[[], Tuple[str, str]]) -> None:
+    """Register an extra GET endpoint: ``fn() -> (content_type, body)``.
+    The daemon mounts its fleet ``/aggregate`` view here."""
+    _EXTRA[path] = fn
+
+
+def _write_discovery_file() -> None:
+    # Advisory: lets other processes (the daemon's status verb) find this
+    # process's ephemeral port.  The exporter itself is already serving, so
+    # an unwritable telemetry dir must not take it down.
+    try:
+        d = _runtime.out_dir()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"flightdeck_{os.getpid()}.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(
+                {
+                    "address": address(),
+                    "pid": os.getpid(),
+                    "run_id": correlate.run_id(),
+                },
+                fh,
+            )
+    except OSError:
+        pass
+
+
+# ------------------------------------------------------------------ handler
+
+
+def _render(path: str) -> Optional[Tuple[str, str]]:
+    """Body for one endpoint, or ``None`` for 404."""
+    # Lazy: metrics/trace/dynamics import this package for their ring feeds.
+    from distkeras_tpu import sanitizer as _sanitizer
+    from distkeras_tpu.telemetry import dynamics as _dynamics
+    from distkeras_tpu.telemetry.metrics import metrics as _registry
+    from distkeras_tpu.telemetry.trace import trace as _tracer
+
+    rec = _flight_recorder
+    rid = correlate.run_id()
+    if path == "/metrics":
+        text = _registry.to_prometheus(labels={"run_id": rid})
+        return ("text/plain; version=0.0.4; charset=utf-8", text)
+    if path == "/healthz":
+        counts: Dict[str, int] = {}
+        for kind, _msg in _sanitizer.violations():
+            counts[kind] = counts.get(kind, 0) + 1
+        body = {
+            "status": "ok",
+            "run_id": rid,
+            "pid": os.getpid(),
+            "unix": time.time(),
+            "uptime_seconds": round(rec.uptime_seconds(), 3),
+            "last_event_unix": rec.last_event_unix(),
+            "last_spans": rec.last_spans(),
+            "watchdog": rec.watchdog_state(),
+            "sanitizer": {"mode": _sanitizer.mode(), "violations": counts},
+        }
+        return ("application/json", json.dumps(body))
+    if path == "/vars":
+        body = {
+            "run_id": rid,
+            "pid": os.getpid(),
+            "metrics": _registry.snapshot(),
+            "phase_breakdown": _registry.phase_breakdown(),
+            "dynamics": _dynamics.last_summary(),
+        }
+        return ("application/json", json.dumps(body))
+    if path == "/trace":
+        payload = rec.trace_export(origin=_tracer._origin)
+        return ("application/json", json.dumps(payload))
+    fn = _EXTRA.get(path)
+    if fn is not None:
+        return fn()
+    return None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "distkeras-flightdeck"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stderr access log
+        pass
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        try:
+            payload = _render(path)
+        except Exception as e:  # noqa: BLE001 — a scrape must never kill training
+            self._reply(500, "text/plain", f"{type(e).__name__}: {e}")
+            return
+        if payload is None:
+            known = ["/metrics", "/healthz", "/vars", "/trace", *sorted(_EXTRA)]
+            self._reply(404, "text/plain", "not found; endpoints: " + " ".join(known))
+            return
+        self._reply(200, *payload)
+
+    def _reply(self, code: int, ctype: str, body: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)

@@ -26,6 +26,7 @@ import math
 import threading
 
 from distkeras_tpu.telemetry import runtime
+from distkeras_tpu.telemetry.flightdeck.recorder import recorder as _flight_recorder
 
 __all__ = [
     "Counter",
@@ -68,6 +69,9 @@ class Counter:
             raise ValueError("counters only go up; use a Gauge")
         with self._lock:
             self._value += amount
+            value = self._value
+        if runtime.enabled():
+            _flight_recorder.record_metric(self.name, value)
 
     @property
     def value(self):
@@ -88,6 +92,8 @@ class Gauge:
     def set(self, value):
         with self._lock:
             self._value = float(value)
+        if runtime.enabled():
+            _flight_recorder.record_metric(self.name, float(value))
 
     @property
     def value(self):
@@ -144,6 +150,21 @@ def _fmt_float(v):
     return s if s else "0"
 
 
+def _label_suffix(labels, first=None):
+    """``{le="0.5",run_id="abc"}`` — ``first`` (a ``(k, v)`` pair) leads so
+    histogram ``le`` keeps its customary position; the rest sort by key.
+    Empty string when there is nothing to render (keeps unlabelled output —
+    and its goldens — byte-identical)."""
+    pairs = []
+    if first is not None:
+        pairs.append(first)
+    if labels:
+        pairs.extend(sorted(labels.items()))
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in pairs) + "}"
+
+
 class Registry:
     """Get-or-create home for named instruments."""
 
@@ -198,10 +219,16 @@ class Registry:
                 }
         return out
 
-    def to_prometheus(self) -> str:
-        """Prometheus text exposition format (v0.0.4)."""
+    def to_prometheus(self, labels=None) -> str:
+        """Prometheus text exposition format (v0.0.4).
+
+        ``labels`` (a flat dict) is stamped onto every sample — the live
+        scrape passes ``{"run_id": ...}`` so fleet dashboards can join
+        processes; ``None`` keeps the output byte-identical to before.
+        """
         with self._lock:
             items = list(self._instruments.items())
+        sfx = _label_suffix(labels)
         lines = []
         for name, inst in sorted(items):
             kind = ("counter" if isinstance(inst, Counter)
@@ -212,11 +239,13 @@ class Registry:
             lines.append(f"# TYPE {name} {kind}")
             if kind == "histogram":
                 for le, n in inst.cumulative():
-                    lines.append(f'{name}_bucket{{le="{le}"}} {n}')
-                lines.append(f"{name}_sum {_fmt_float(inst.sum)}")
-                lines.append(f"{name}_count {inst.count}")
+                    lines.append(
+                        f"{name}_bucket{_label_suffix(labels, ('le', le))} {n}"
+                    )
+                lines.append(f"{name}_sum{sfx} {_fmt_float(inst.sum)}")
+                lines.append(f"{name}_count{sfx} {inst.count}")
             else:
-                lines.append(f"{name} {_fmt_float(inst.value)}")
+                lines.append(f"{name}{sfx} {_fmt_float(inst.value)}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_jsonl(self, path, extra=None) -> str:
@@ -332,12 +361,14 @@ def merge_snapshots(snapshots) -> dict:
     return merged
 
 
-def prometheus_from_snapshot(snapshot, help_map=None) -> str:
+def prometheus_from_snapshot(snapshot, help_map=None, labels=None) -> str:
     """Prometheus text exposition for a snapshot dict (per-job or merged).
 
     Merged gauges (carrying a ``mean``) export two labelled samples,
     ``{agg="max"}`` and ``{agg="mean"}``; everything else renders exactly
-    like :meth:`Registry.to_prometheus`."""
+    like :meth:`Registry.to_prometheus`.  ``labels`` stamps every sample
+    (the fleet scrape passes the run_id) and composes with ``le``/``agg``."""
+    sfx = _label_suffix(labels)
     lines = []
     for name, payload in sorted(snapshot.items()):
         kind = payload["type"]
@@ -347,14 +378,18 @@ def prometheus_from_snapshot(snapshot, help_map=None) -> str:
         lines.append(f"# TYPE {name} {kind}")
         if kind == "histogram":
             for le, n in payload["buckets"].items():
-                lines.append(f'{name}_bucket{{le="{le}"}} {n}')
-            lines.append(f"{name}_sum {_fmt_float(payload['sum'])}")
-            lines.append(f"{name}_count {payload['count']}")
+                lines.append(
+                    f"{name}_bucket{_label_suffix(labels, ('le', le))} {n}"
+                )
+            lines.append(f"{name}_sum{sfx} {_fmt_float(payload['sum'])}")
+            lines.append(f"{name}_count{sfx} {payload['count']}")
         elif kind == "gauge" and "mean" in payload:
-            lines.append(f'{name}{{agg="max"}} {_fmt_float(payload["value"])}')
-            lines.append(f'{name}{{agg="mean"}} {_fmt_float(payload["mean"])}')
+            max_sfx = _label_suffix(labels, ("agg", "max"))
+            mean_sfx = _label_suffix(labels, ("agg", "mean"))
+            lines.append(f"{name}{max_sfx} {_fmt_float(payload['value'])}")
+            lines.append(f"{name}{mean_sfx} {_fmt_float(payload['mean'])}")
         else:
-            lines.append(f"{name} {_fmt_float(payload['value'])}")
+            lines.append(f"{name}{sfx} {_fmt_float(payload['value'])}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -32,6 +32,8 @@ import threading
 import time
 
 from distkeras_tpu.telemetry import runtime
+from distkeras_tpu.telemetry.flightdeck import correlate as _correlate
+from distkeras_tpu.telemetry.flightdeck.recorder import recorder as _flight_recorder
 from distkeras_tpu.telemetry.metrics import metrics as _registry
 
 __all__ = ["Span", "Tracer", "trace"]
@@ -85,11 +87,17 @@ class Tracer:
 
     ``clock`` and ``pid`` are injectable so golden-file tests are
     deterministic; production code uses the module-global :data:`trace`.
+
+    Only a ``correlated`` tracer stamps the fleet ``run_id`` into event args
+    and feeds finished spans to the flight-recorder ring — the module-global
+    :data:`trace` is; ad-hoc tracers (golden tests, scripts) default to
+    uncorrelated so their output is a pure function of their inputs.
     """
 
-    def __init__(self, clock=time.perf_counter, pid=None):
+    def __init__(self, clock=time.perf_counter, pid=None, correlated=False):
         self._clock = clock
         self._pid = pid
+        self._correlated = correlated
         self._lock = threading.Lock()
         self._events = []
         self._tls = threading.local()
@@ -128,9 +136,13 @@ class Tracer:
         args = dict(attrs)
         if parent is not None:
             args["parent"] = parent
+        if self._correlated:
+            rid = _correlate.current()
+            if rid is not None:
+                args["run_id"] = rid
         with self._lock:
             tid = self._tids.setdefault(ident, len(self._tids))
-            self._events.append({
+            event = {
                 "name": name,
                 "cat": "distkeras",
                 "ph": "X",
@@ -139,7 +151,10 @@ class Tracer:
                 "ts": round((t0 - self._origin) * 1e6, 3),
                 "dur": round((t1 - t0) * 1e6, 3),
                 "args": args,
-            })
+            }
+            self._events.append(event)
+        if self._correlated:
+            _flight_recorder.record_span(event)
 
     def reset(self):
         with self._lock:
@@ -166,5 +181,6 @@ class Tracer:
         return path
 
 
-# Process-global tracer used by all instrumentation sites.
-trace = Tracer()
+# Process-global tracer used by all instrumentation sites; correlated so its
+# events carry the fleet run_id and land in the flight-recorder ring.
+trace = Tracer(correlated=True)

@@ -288,7 +288,26 @@ class Trainer:
             ).inc()
         return state
 
-    def _fit(
+    def _fit(self, *args, **kwargs):
+        """Crash-forensics boundary around :meth:`_fit_inner`.
+
+        Mints/propagates the fleet ``run_id``, starts the live HTTP exporter
+        when one is configured, and — on ANY unhandled exception, including
+        watchdog halts and strict sanitizer violations — dumps the
+        flight-recorder blackbox into the telemetry dir before re-raising.
+        With telemetry off this is one cached-bool check per fit.
+        """
+        if telemetry.enabled():
+            telemetry.flightdeck.activate()
+        try:
+            return self._fit_inner(*args, **kwargs)
+        except Exception as e:
+            if telemetry.enabled():
+                telemetry.flightdeck.on_crash(
+                    f"{type(self).__name__}._fit: {type(e).__name__}: {e}")
+            raise
+
+    def _fit_inner(
         self,
         dataframe: DataFrame,
         rule,

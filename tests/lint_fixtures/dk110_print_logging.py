@@ -1,0 +1,28 @@
+"""DK110 fixture: print()/logging.getLogger() bypassing telemetry.
+
+The checker only fires inside the ``distkeras_tpu`` package, so the test
+copies this source under a synthetic ``distkeras_tpu/`` root before
+analyzing it — line numbers below are asserted exactly.
+"""
+
+import logging
+
+from logging import getLogger
+
+
+def train_step(x):
+    print("loss:", x)
+    log = logging.getLogger(__name__)
+    named = getLogger("distkeras")
+    return x, log, named
+
+
+def ok_paths(x):
+    message = "print this"  # a string, not a call
+    emit = print  # a reference, not a call
+    print("suppressed")  # dklint: disable=DK110
+    return x, message, emit
+
+
+if __name__ == "__main__":
+    print("script entry points keep their stdout")

@@ -388,6 +388,55 @@ def test_main_emits_json_line_when_config_raises(monkeypatch, capsys):
     assert "compile exploded" in parsed["error"]
 
 
+def test_preflight_exhausted_timeouts_count_init_failures(monkeypatch):
+    """Every failed probe lands in the bench_backend_init_failures counter,
+    including the retries-exhausted/timeout branch — a fallback record must
+    say HOW flaky the backend was."""
+    from distkeras_tpu import telemetry
+
+    telemetry.metrics.reset()
+    monkeypatch.setattr(
+        bench, "_probe_subprocess",
+        lambda timeout: (False, "backend init timed out after 1s"))
+    out = bench.preflight(max_tries=3, init_timeout=1, retry_sleep=0)
+    assert "timed out" in out["error"]
+    snap = telemetry.metrics.snapshot()
+    assert snap["bench_backend_init_failures"]["value"] == 3.0
+    telemetry.metrics.reset()
+
+
+def test_ensure_backend_routes_timeout_through_cpu_fallback(monkeypatch):
+    """The retries-exhausted/timeout branch takes the same CPU-smoke road as
+    an UNAVAILABLE tunnel: ensure_backend records the reason and re-probes
+    once on the CPU mesh instead of emitting error verdicts."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(bench, "_PLATFORM_FALLBACK", None)
+    probes = []
+
+    def timing_out_preflight(**kw):
+        probes.append(kw)
+        if len(probes) == 1:
+            return {"error": "backend init timed out after 120s"}
+        return {"n": 8, "platform": "cpu", "kind": "cpu"}
+
+    monkeypatch.setattr(bench, "preflight", timing_out_preflight)
+    backend = bench.ensure_backend(["m"])
+    assert backend == {"n": 8, "platform": "cpu", "kind": "cpu"}
+    assert "timed out" in bench._PLATFORM_FALLBACK
+    assert probes == [{}, {"max_tries": 1}]
+
+
+def test_ensure_backend_emits_error_per_pending_metric(monkeypatch, capsys):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(bench, "_PLATFORM_FALLBACK", None)
+    monkeypatch.setattr(bench, "preflight",
+                        lambda **kw: {"error": "UNAVAILABLE: nope"})
+    assert bench.ensure_backend(["m_a", "m_b"]) is None
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert [l["metric"] for l in lines] == ["m_a", "m_b"]
+    assert all("CPU fallback also failed" in l["error"] for l in lines)
+
+
 def test_preflight_succeeds_after_live_probe(monkeypatch):
     # The child probe targets the default backend (TPU under the driver);
     # here it's stubbed live so preflight proceeds to the in-process init,

@@ -1,0 +1,238 @@
+"""``tools.dktrace`` tests: merging per-process Chrome traces into one fleet
+timeline — deterministic synthetic golden, dispatch-window anchoring, label
+and metadata layout, CLI exit codes, and an end-to-end run where two daemon
+jobs' traces merge with the daemon's own into a single Perfetto-loadable
+timeline sharing one run_id."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.job_deployment import Job, PunchcardServer
+from distkeras_tpu.telemetry.flightdeck import correlate
+from tools.dktrace import merge_trace_dirs
+from tools.dktrace.__main__ import main as dktrace_main
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+def _ev(name, ts, dur, pid, args):
+    return {"name": name, "cat": "distkeras", "ph": "X", "ts": ts, "dur": dur,
+            "pid": pid, "tid": 1, "args": args}
+
+
+# One daemon that dispatched two jobs: job-a at ts 1000 on the daemon's
+# axis, job-b at ts 5000.  Each job's own trace starts near its process-local
+# origin (ts 50 / 80) — the merge must land them inside their dispatch
+# windows.  All values hand-picked so the merged output is byte-stable.
+DAEMON_EVENTS = [
+    _ev("job_run", 1000.0, 3000.0, 100,
+        {"job_id": "job-a", "run_id": "fleet1234"}),
+    _ev("job_run", 5000.0, 2500.0, 100,
+        {"job_id": "job-b", "run_id": "fleet1234"}),
+]
+JOB_A_EVENTS = [
+    _ev("epoch", 50.0, 2000.0, 201, {"epoch": 0, "run_id": "fleet1234"}),
+    _ev("window", 60.0, 500.0, 201,
+        {"parent": "epoch", "run_id": "fleet1234"}),
+]
+JOB_B_EVENTS = [
+    _ev("epoch", 80.0, 1800.0, 202, {"epoch": 0, "run_id": "fleet1234"}),
+]
+
+
+def _write_trace(directory, fname, events):
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, fname), "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+
+
+def fleet_dirs(root):
+    """The synthetic fleet the golden file pins: daemon + two jobs."""
+    d = os.path.join(str(root), "daemon")
+    a = os.path.join(str(root), "job-a")
+    b = os.path.join(str(root), "job-b")
+    _write_trace(d, "trace_100.json", DAEMON_EVENTS)
+    _write_trace(a, "trace_201.json", JOB_A_EVENTS)
+    _write_trace(b, "trace_202.json", JOB_B_EVENTS)
+    return [d, a, b]
+
+
+# ----------------------------------------------------------------- merging
+
+def test_merge_matches_golden(tmp_path):
+    merged = merge_trace_dirs(fleet_dirs(tmp_path))
+    golden = json.load(open(os.path.join(GOLDEN, "dktrace_merge.json")))
+    assert merged == golden
+
+
+def test_merge_anchors_jobs_inside_their_dispatch_windows(tmp_path):
+    merged = merge_trace_dirs(fleet_dirs(tmp_path))
+    evs = merged["traceEvents"]
+    by_pid = {}
+    for e in evs:
+        if e.get("ph") == "M":
+            by_pid[e["args"]["name"]] = e["pid"]
+    assert by_pid == {"daemon": 1, "job-a": 2, "job-b": 3}
+
+    runs = {e["args"]["job_id"]: e for e in evs if e.get("name") == "job_run"}
+    epochs = {e["pid"]: e for e in evs if e.get("name") == "epoch"}
+    # daemon axis is the merged origin: its first dispatch starts at 0
+    assert runs["job-a"]["ts"] == 0.0
+    assert runs["job-b"]["ts"] == 4000.0
+    # each job's first event lands exactly at the start of its dispatch span
+    assert epochs[by_pid["job-a"]]["ts"] == runs["job-a"]["ts"]
+    assert epochs[by_pid["job-b"]]["ts"] == runs["job-b"]["ts"]
+    # intra-job spacing is preserved (window started 10us after epoch)
+    window = next(e for e in evs if e.get("name") == "window")
+    assert window["ts"] - epochs[by_pid["job-a"]]["ts"] == pytest.approx(10.0)
+    assert merged["otherData"] == {
+        "run_ids": ["fleet1234"],
+        "processes": ["daemon", "job-a", "job-b"],
+    }
+
+
+def test_merge_unmatched_dir_normalises_to_zero(tmp_path):
+    solo = os.path.join(str(tmp_path), "solo")
+    _write_trace(solo, "trace_9.json",
+                 [_ev("epoch", 777.0, 10.0, 9, {"run_id": "r1"})])
+    merged = merge_trace_dirs([solo])
+    ep = next(e for e in merged["traceEvents"] if e["name"] == "epoch")
+    assert (ep["ts"], ep["pid"]) == (0.0, 1)
+
+
+def test_merge_labels_multi_process_dirs(tmp_path):
+    d = os.path.join(str(tmp_path), "host")
+    _write_trace(d, "trace_11.json", [_ev("a", 0.0, 1.0, 11, {})])
+    _write_trace(d, "trace_22.json", [_ev("b", 0.0, 1.0, 22, {})])
+    merged = merge_trace_dirs([d])
+    labels = [e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("ph") == "M"]
+    assert labels == ["host/11", "host/22"]
+
+
+def test_merge_without_traces_raises(tmp_path):
+    with pytest.raises(ValueError, match="no trace"):
+        merge_trace_dirs([str(tmp_path)])
+
+
+def test_merge_rejects_corrupt_trace(tmp_path):
+    d = os.path.join(str(tmp_path), "bad")
+    os.makedirs(d)
+    open(os.path.join(d, "trace_1.json"), "w").write("{not json")
+    with pytest.raises(ValueError, match="unreadable"):
+        merge_trace_dirs([d])
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_merge_writes_perfetto_loadable_output(tmp_path, capsys):
+    out = str(tmp_path / "merged.json")
+    assert dktrace_main(["merge", *fleet_dirs(tmp_path), "-o", out]) == 0
+    payload = json.load(open(out))
+    assert payload == merge_trace_dirs(fleet_dirs(tmp_path))
+    cap = capsys.readouterr()
+    assert cap.out == ""  # the trace goes to the file, not the terminal
+    assert "5 events across 3 processes" in cap.err
+
+
+def test_cli_merge_stdout_and_exit_codes(tmp_path, capsys):
+    dirs = fleet_dirs(tmp_path)
+    assert dktrace_main(["merge", *dirs]) == 0
+    cap = capsys.readouterr()
+    assert json.loads(cap.out)["otherData"]["run_ids"] == ["fleet1234"]
+    assert cap.err == ""  # single run_id: no warning
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert dktrace_main(["merge", empty]) == 2
+    assert "no trace" in capsys.readouterr().err
+
+
+def test_cli_warns_on_mixed_run_ids(tmp_path, capsys):
+    a = os.path.join(str(tmp_path), "a")
+    b = os.path.join(str(tmp_path), "b")
+    _write_trace(a, "trace_1.json", [_ev("x", 0.0, 1.0, 1, {"run_id": "r1"})])
+    _write_trace(b, "trace_2.json", [_ev("y", 0.0, 1.0, 2, {"run_id": "r2"})])
+    assert dktrace_main(["merge", a, b, "-o",
+                         str(tmp_path / "out.json")]) == 0
+    assert "2 distinct run_ids" in capsys.readouterr().err
+
+
+def test_cli_runs_as_module(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "merged.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dktrace", "merge",
+         *fleet_dirs(tmp_path), "-o", out],
+        capture_output=True, text=True, cwd=repo, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert json.load(open(out))["otherData"]["run_ids"] == ["fleet1234"]
+
+
+# -------------------------------------------------------------- end to end
+
+_TRACE_JOB = """\
+from distkeras_tpu import telemetry
+
+with telemetry.trace.span("epoch", epoch=0):
+    with telemetry.trace.span("window"):
+        pass
+telemetry.flush()
+"""
+
+
+def test_two_daemon_jobs_merge_into_one_fleet_timeline(tmp_path, monkeypatch):
+    """Acceptance: two jobs run under a daemon; ``dktrace merge`` over the
+    daemon's dir and both job dirs yields one timeline with three distinct
+    process names and every span stamped with the same fleet run_id."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("PYTHONPATH", repo)
+    monkeypatch.setenv("DISTKERAS_TELEMETRY_DIR", str(tmp_path))
+    telemetry.configure(True)
+    telemetry.trace.reset()
+    telemetry.metrics.reset()
+    correlate.set_run_id("fleetrun")
+    server = PunchcardServer(port=0, secret="s3cret")
+    server.start()
+    try:
+        job_dirs = []
+        for _ in range(2):
+            job = Job("127.0.0.1", server.port, secret="s3cret",
+                      script=_TRACE_JOB)
+            job.submit()
+            st = job.wait(timeout=120)
+            assert st["status"] == "finished", st.get("output")
+            job_dirs.append(st["telemetry_dir"])
+    finally:
+        server.stop()  # flushes the daemon's own trace into tmp_path
+        telemetry.trace.reset()
+        telemetry.metrics.reset()
+        correlate.set_run_id(None)
+        telemetry.configure(None)
+
+    merged = merge_trace_dirs([str(tmp_path), *job_dirs])
+    json.dumps(merged)  # Perfetto-loadable: plain JSON through and through
+    names = [e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M"]
+    assert len(names) == 3 and len(set(names)) == 3
+
+    epochs = [e for e in merged["traceEvents"] if e.get("name") == "epoch"]
+    runs = {e["args"]["job_id"]: e for e in merged["traceEvents"]
+            if e.get("name") == "job_run"}
+    assert len(epochs) == 2 and len(runs) == 2
+    rids = {e["args"]["run_id"] for e in epochs}
+    rids |= {e["args"]["run_id"] for e in runs.values()}
+    assert rids == {"fleetrun"}
+    assert merged["otherData"]["run_ids"] == ["fleetrun"]
+    # anchoring: each job's epoch sits inside its daemon-side dispatch window
+    for e in epochs:
+        base = os.path.basename(
+            job_dirs[e["pid"] - 2])  # pids follow input order: daemon is 1
+        w = runs[base]
+        assert w["ts"] <= e["ts"] <= w["ts"] + w["dur"]

@@ -201,6 +201,41 @@ def test_dk109_exemptions_and_suppression():
     assert 43 not in lines  # @jax.jit-decorated fn is DK102's territory
 
 
+def _run_dk110(tmp_path):
+    """DK110 only fires inside the ``distkeras_tpu`` package, so the fixture
+    is analyzed from a synthetic package root rather than the checkout."""
+    src = open(os.path.join(FIXTURES, "dk110_print_logging.py")).read()
+    pkg = tmp_path / "distkeras_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "hot.py").write_text(src)
+    findings, _ = analyze([str(pkg / "hot.py")], root=str(tmp_path),
+                          select=["DK110"])
+    return [(f.rule, f.line) for f in findings]
+
+
+def test_dk110_print_logging_fixture(tmp_path):
+    assert _run_dk110(tmp_path) == [
+        ("DK110", 14),  # print() in a hot module
+        ("DK110", 15),  # logging.getLogger(__name__)
+        ("DK110", 16),  # from-imported getLogger alias
+    ]
+
+
+def test_dk110_exemptions_and_suppression(tmp_path):
+    lines = [ln for _, ln in _run_dk110(tmp_path)]
+    assert 22 not in lines  # `emit = print` reference, not a call
+    assert 23 not in lines  # suppressed
+    assert 28 not in lines  # __main__ guard block is a script entry point
+
+
+def test_dk110_out_of_package_is_silent():
+    # the same source analyzed as tests.lint_fixtures.* is out of scope —
+    # tools/ and tests/ keep their CLIs and fixtures
+    got, _ = _run("dk110_print_logging.py", ["DK110"])
+    assert got == []
+
+
 # ------------------------------------------------------ interprocedural v2
 
 def test_cross_module_host_sync_found_by_v2():
@@ -317,7 +352,7 @@ def test_baseline_cancels_and_reports_stale(tmp_path):
 def test_all_rules_registered():
     assert sorted(all_rules()) == [
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
-        "DK108", "DK109",
+        "DK108", "DK109", "DK110",
     ]
 
 

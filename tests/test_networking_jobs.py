@@ -86,6 +86,50 @@ def test_job_failure_reported(punchcard):
     assert st["status"] == "failed" and st["returncode"] == 3
 
 
+def test_status_verb_reports_telemetry_surface(punchcard, tmp_path,
+                                               monkeypatch):
+    """The status verb carries each job's telemetry dir, live HTTP address
+    (None while flightdeck is off), and a last-heartbeat timestamp derived
+    from the job's telemetry files."""
+    import os
+
+    from distkeras_tpu import telemetry
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("PYTHONPATH", repo)
+    monkeypatch.setenv("DISTKERAS_TELEMETRY_DIR", str(tmp_path))
+    telemetry.configure(True)
+    try:
+        job = Job("127.0.0.1", punchcard.port, secret="s3cret",
+                  script="from distkeras_tpu import telemetry\n"
+                         "telemetry.metrics.counter('c').inc()\n"
+                         "telemetry.flush()\n")
+        job.submit()
+        st = job.wait(timeout=120)
+        assert st["status"] == "finished", st.get("output")
+        assert st["telemetry_dir"] == os.path.join(
+            punchcard.workdir, "telemetry", job.job_id)
+        assert st["http"] is None  # no DISTKERAS_TELEMETRY_HTTP: no exporter
+        # heartbeat falls back to the flushed files' mtime when there is no
+        # live exporter to ask
+        assert isinstance(st["last_heartbeat"], float)
+    finally:
+        telemetry.trace.reset()
+        telemetry.metrics.reset()
+        telemetry.configure(None)
+
+
+def test_status_verb_without_telemetry_has_null_surface(punchcard):
+    job = Job("127.0.0.1", punchcard.port, secret="s3cret",
+              script="print('ok')")
+    job.submit()
+    st = job.wait(timeout=30)
+    assert st["status"] == "finished"
+    assert st["telemetry_dir"] is None
+    assert st["http"] is None
+    assert st["last_heartbeat"] is None
+
+
 def test_job_bad_secret_denied(punchcard):
     job = Job("127.0.0.1", punchcard.port, secret="wrong", script="print(1)")
     with pytest.raises(RuntimeError):

@@ -7,6 +7,7 @@ from tools.dklint.checkers import (  # noqa: F401 — registration side effects
     host_sync,
     locks,
     mesh_axes,
+    printlog,
     recompile,
     traced_branch,
     wallclock,

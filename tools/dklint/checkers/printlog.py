@@ -1,0 +1,89 @@
+"""DK110 — print()/bare logging.getLogger() bypassing the telemetry registry.
+
+Package modules route operator-visible signals through the telemetry
+registry (counters/gauges a scrape can see) or Python warnings (which the
+test suite can assert on).  A stray ``print`` inside ``distkeras_tpu/``
+writes to a stdout nobody aggregates — on a pod, N processes' interleaved
+lines — and a bare ``logging.getLogger(...)`` builds a logger hierarchy none
+of the exporters (Prometheus scrape, JSONL flush, fleet merge) ever see.
+
+Scope: modules under the ``distkeras_tpu`` package only — ``tools/``,
+``tests/``, and ``examples/`` keep their CLIs and fixtures.  A module-level
+``if __name__ == "__main__":`` block is exempt (a script entry point prints
+its own output by design), as is anything under a ``# dklint:
+disable=DK110`` comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Tuple
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+
+
+def _is_main_guard(test: ast.AST) -> bool:
+    """``__name__ == "__main__"`` (either operand order)."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Eq)):
+        return False
+    operands = [test.left, test.comparators[0]]
+    has_name = any(isinstance(o, ast.Name) and o.id == "__name__"
+                   for o in operands)
+    has_main = any(isinstance(o, ast.Constant) and o.value == "__main__"
+                   for o in operands)
+    return has_name and has_main
+
+
+@register
+class PrintBypassesTelemetry(Checker):
+    rule = "DK110"
+    name = "print-bypasses-telemetry"
+    description = (
+        "print()/bare logging.getLogger() in a distkeras_tpu module "
+        "bypasses the telemetry registry"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        mod = fi.module or ""
+        if mod != "distkeras_tpu" and not mod.startswith("distkeras_tpu."):
+            return
+        exempt: List[Tuple[int, int]] = []
+        for node in fi.tree.body:
+            if isinstance(node, ast.If) and _is_main_guard(node.test):
+                exempt.append((node.lineno, node.end_lineno or node.lineno))
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(lo <= node.lineno <= hi for lo, hi in exempt):
+                continue
+            name = call_name(node) or ""
+            head, _, rest = name.partition(".")
+            resolved = fi.imports.get(head)
+            if resolved:
+                name = resolved + ("." + rest if rest else "")
+            if name == "print":
+                yield Finding(
+                    path=fi.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.rule,
+                    message=(
+                        "print() in a distkeras_tpu module writes to a "
+                        "stdout nobody aggregates — bump a telemetry "
+                        "counter/gauge or raise a warning instead"
+                    ),
+                )
+            elif name == "logging.getLogger":
+                yield Finding(
+                    path=fi.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.rule,
+                    message=(
+                        "bare logging.getLogger() builds a logger the "
+                        "telemetry exporters never see — route signals "
+                        "through the telemetry registry"
+                    ),
+                )

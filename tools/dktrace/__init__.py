@@ -1,0 +1,14 @@
+"""dktrace — fleet trace tooling for distkeras_tpu telemetry output.
+
+``python -m tools.dktrace merge <dir>...`` merges the per-process Chrome
+traces that ``telemetry.flush()`` writes (one ``trace_<pid>.json`` per
+process, each on its own ``perf_counter`` axis) into ONE Perfetto-loadable
+timeline: distinct ``pid``/``process_name`` metadata per input, clock-skew
+alignment of job traces into the daemon's ``job_run`` dispatch windows, and
+a run_id cross-check so traces from different fleets don't get silently
+stitched together.
+"""
+
+from tools.dktrace.merge import merge_trace_dirs
+
+__all__ = ["merge_trace_dirs"]

@@ -1,0 +1,55 @@
+"""CLI: ``python -m tools.dktrace merge DIR... [-o OUT]``."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.dktrace.merge import merge_trace_dirs
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.dktrace",
+        description="fleet trace tooling for distkeras_tpu telemetry output",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    merge = sub.add_parser(
+        "merge",
+        help="merge per-process trace dirs into one Perfetto timeline",
+    )
+    merge.add_argument("dirs", nargs="+", metavar="DIR",
+                       help="telemetry dirs holding trace_<pid>.json files")
+    merge.add_argument("-o", "--output", default=None,
+                       help="write merged JSON here (default: stdout)")
+    args = parser.parse_args(argv)
+
+    try:
+        payload = merge_trace_dirs(args.dirs)
+    except ValueError as e:
+        print(f"dktrace: error: {e}", file=sys.stderr)
+        return 2
+    run_ids = payload["otherData"]["run_ids"]
+    if len(run_ids) > 1:
+        print(
+            f"dktrace: warning: merged {len(run_ids)} distinct run_ids "
+            f"({', '.join(run_ids)}) — are these really one fleet run?",
+            file=sys.stderr,
+        )
+    text = json.dumps(payload, indent=1)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        n_events = sum(1 for e in payload["traceEvents"] if e.get("ph") != "M")
+        n_procs = len(payload["otherData"]["processes"])
+        print(f"dktrace: wrote {args.output} "
+              f"({n_events} events across {n_procs} processes)",
+              file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Merge per-process Chrome traces into one fleet timeline.
+
+Every process traces on its own ``time.perf_counter()`` axis with origin 0
+at tracer construction, so two jobs' traces overlap at ts 0 even though the
+daemon dispatched them minutes apart.  The daemon's ``job_run`` span (opened
+around the job subprocess, ``args.job_id`` = the job's telemetry-dir name)
+records the real dispatch window on the daemon's axis — the merge anchors
+each job's first event at the start of its dispatch window, which bounds the
+clock skew by the subprocess startup time and needs no cross-machine clock
+agreement.  Inputs without a matching dispatch span are normalised to start
+at 0 (still one timeline, just not fleet-aligned).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["merge_trace_dirs"]
+
+
+def _load_dir(directory: str) -> List[Tuple[str, list]]:
+    """``[(filename, trace_events), ...]`` for each ``trace_*.json`` under
+    ``directory`` (sorted, so merges are deterministic)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory, "trace_*.json"))):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise ValueError(f"unreadable trace {path}: {e}") from e
+        events = payload.get("traceEvents", [])
+        if not isinstance(events, list):
+            raise ValueError(f"{path}: traceEvents is not a list")
+        out.append((os.path.basename(path), events))
+    return out
+
+
+def merge_trace_dirs(dirs) -> dict:
+    """Merge every ``trace_*.json`` under ``dirs`` into one Chrome trace.
+
+    Returns the merged trace object: each input file becomes its own
+    ``pid`` with a ``process_name`` metadata event (``<dir-basename>`` or
+    ``<dir-basename>/<pid>`` when a dir holds several processes), events
+    keep their args (including ``args.run_id``), and job traces are shifted
+    onto the daemon's axis via its ``job_run`` dispatch spans.  The
+    ``otherData`` block carries the distinct run_ids and process labels for
+    cross-checks.  Raises ``ValueError`` when no trace files are found.
+    """
+    procs = []
+    for d in dirs:
+        d = os.path.normpath(d)
+        base = os.path.basename(d)
+        loaded = _load_dir(d)
+        for fname, events in loaded:
+            suffix = fname[len("trace_"):-len(".json")]
+            label = base if len(loaded) == 1 else f"{base}/{suffix}"
+            procs.append({"label": label, "dir": base, "events": events})
+    if not procs:
+        raise ValueError(
+            "no trace_*.json found under: " + ", ".join(map(str, dirs))
+        )
+
+    # The daemon is whichever input carries job_run dispatch spans; its
+    # windows key the per-job shifts, and its own axis is the merged origin.
+    windows: Dict[str, float] = {}
+    daemon_index: Optional[int] = None
+    for i, proc in enumerate(procs):
+        for e in proc["events"]:
+            if e.get("name") == "job_run" and "job_id" in e.get("args", {}):
+                windows[str(e["args"]["job_id"])] = float(e["ts"])
+                daemon_index = i
+    daemon_min = 0.0
+    if daemon_index is not None:
+        daemon_min = min(
+            (float(e["ts"]) for e in procs[daemon_index]["events"]), default=0.0
+        )
+
+    merged = []
+    run_ids = set()
+    for new_pid, proc in enumerate(procs, start=1):
+        events = proc["events"]
+        min_ts = min((float(e["ts"]) for e in events), default=0.0)
+        if daemon_index is not None and new_pid - 1 == daemon_index:
+            shift = -daemon_min
+        elif proc["dir"] in windows:
+            # anchor the job's first event at the daemon's dispatch of it
+            shift = windows[proc["dir"]] - daemon_min - min_ts
+        else:
+            shift = -min_ts
+        merged.append({
+            "name": "process_name",
+            "ph": "M",
+            "pid": new_pid,
+            "tid": 0,
+            "args": {"name": proc["label"]},
+        })
+        for e in events:
+            e2 = dict(e)
+            e2["pid"] = new_pid
+            e2["ts"] = round(float(e["ts"]) + shift, 3)
+            merged.append(e2)
+            rid = e.get("args", {}).get("run_id")
+            if rid:
+                run_ids.add(rid)
+
+    merged.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               e.get("pid", 0), e.get("ts", 0.0)))
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "run_ids": sorted(run_ids),
+            "processes": [p["label"] for p in procs],
+        },
+    }
