@@ -727,8 +727,17 @@ def _join_reps_broadcast():
     collective — and the sweep dies at the deadman having measured
     nothing."""
     global _REPS_BCASTS
+    import jax
     from jax.experimental import multihost_utils
 
+    # Process 0 owns every first-k-devices sub-mesh, so it always reaches
+    # _calibrate_reps and is the broadcast SOURCE — if it ever lands here
+    # the dummy int32 0 below would be broadcast as the fleet's reps count
+    # and every process would time a 0-epoch program (ADVICE.md round 5).
+    assert jax.process_index() != 0, (
+        "_join_reps_broadcast on process 0: the broadcast source cannot "
+        "join as a receiver — run_config should have calibrated here"
+    )
     multihost_utils.broadcast_one_to_all(np.int32(0))
     _REPS_BCASTS += 1
 
@@ -1005,6 +1014,15 @@ def run_scaling(config: str = HEADLINE, run_kw: dict = None) -> dict:
                 # need every process to join it.  A post-calibration
                 # failure already joined (counter moved) and must not
                 # join twice.
+                #
+                # INVARIANT: each run_config point performs exactly ONE
+                # global reps broadcast per process when reps is auto
+                # (reps=None) — either inside _calibrate_reps (owners) or
+                # here via _join_reps_broadcast (non-owners) — and ZERO
+                # when reps is pinned.  The _REPS_BCASTS counter delta
+                # across the try block is how this branch tells the two
+                # failure timings apart; a third joining path would break
+                # the count and wedge the fleet inside the collective.
                 _join_reps_broadcast()
         # Cross-process barrier per point — taken on EVERY path, success,
         # skip, or failure: a process that skipped a point (or aborted the
@@ -1172,6 +1190,82 @@ def run_streaming(config: str = HEADLINE, n_windows: int = 8, reps: int = None,
     }
 
 
+def run_serving(n_requests: int = 64, num_slots: int = 8, page_size: int = 16,
+                max_new_tokens: int = 32, dim: int = 256, heads: int = 8,
+                num_layers: int = 4, max_len: int = 256,
+                vocab: int = 4096) -> dict:
+    """Online-serving SLO measurement: offered load through the continuous
+    batching engine (``distkeras_tpu.serving``), reporting decode
+    throughput and the latency quantiles an operator would alert on.
+
+    Requests arrive back-to-back (closed loop, windowed by the queue bound)
+    with mixed prompt lengths, so the number measures steady-state
+    continuous batching — admissions and retirements interleaved with
+    decode steps — not a lockstep batch.  TTFT/token-latency quantiles are
+    read back from the same ``serving_*`` histograms flightdeck scrapes,
+    so the bench exercises the exact metrics surface production would."""
+    import jax
+
+    from distkeras_tpu.models.transformer import TransformerLM
+    from distkeras_tpu.serving import GenerateRequest, QueueFull, ServingEngine
+    from distkeras_tpu.telemetry.metrics import Registry
+
+    model = TransformerLM(vocab_size=vocab, dim=dim, heads=heads,
+                          num_layers=num_layers, max_len=max_len)
+    rng = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))["params"]
+    registry = Registry()  # private: a bench must not pollute the scrape
+    engine = ServingEngine(model, params, num_slots=num_slots,
+                           page_size=page_size, queue_size=num_slots * 4,
+                           registry=registry)
+    prompts = [rng.randint(0, vocab, size=int(n)).tolist()
+               for n in rng.randint(4, max_len - max_new_tokens,
+                                    size=n_requests)]
+    # warmup: compile prefill + decode outside the timed region
+    engine.generate(prompts[0], max_new_tokens=2, timeout=300.0)
+
+    pending = []
+    t0 = time.perf_counter()
+    for prompt in prompts:
+        req = GenerateRequest(prompt=prompt, max_new_tokens=max_new_tokens)
+        while True:
+            try:
+                pending.append(engine.submit(req))
+                break
+            except QueueFull:
+                pending.pop(0).result(timeout=300.0)
+    results = [p.result(timeout=300.0) for p in pending]
+    wall = time.perf_counter() - t0
+    engine.stop()
+    done = [r for r in results if r is not None]
+    total_tokens = sum(len(r.tokens) for r in done)
+
+    def q(values, frac):
+        if not values:
+            return None
+        ordered = sorted(values)
+        return round(ordered[min(len(ordered) - 1,
+                                 int(frac * len(ordered)))], 4)
+
+    ttfts = [r.ttft_s for r in done]
+    lats = [r.latency_s for r in done]
+    return {
+        "metric": "serving_tokens_per_sec",
+        "value": round(total_tokens / wall, 1) if wall > 0 else None,
+        "unit": "generated tokens/sec through continuous batching",
+        "vs_baseline": None,
+        "requests": len(done),
+        "num_slots": num_slots,
+        "ttft_p50_s": q(ttfts, 0.50),
+        "ttft_p99_s": q(ttfts, 0.99),
+        "request_latency_p50_s": q(lats, 0.50),
+        "request_latency_p99_s": q(lats, 0.99),
+        "protocol": "closed-loop offered load, mixed prompt lengths, "
+                    "greedy sampling; warmup compile excluded",
+    }
+
+
 def write_baseline(results: dict) -> None:
     """Pin the current sweep as the regression baseline, stamped with the
     protocol it was measured under (``--write-baseline``)."""
@@ -1205,6 +1299,9 @@ def main():
     parser.add_argument("--mfu-ceiling", action="store_true",
                         help="append a measured per-layer-roofline MFU-ceiling "
                         "line per requested config")
+    parser.add_argument("--serving", action="store_true",
+                        help="append an online-serving SLO line (continuous "
+                        "batching tokens/sec + TTFT/latency quantiles)")
     parser.add_argument("--write-baseline", action="store_true",
                         help="pin this sweep's medians (+ protocol) as "
                         "bench_baseline.json")
@@ -1259,6 +1356,8 @@ def main():
         pending.append(f"{HEADLINE}_streaming_overhead")
     if args.mfu_ceiling:
         pending.extend(f"{c}_mfu_ceiling" for c in configs)
+    if args.serving:
+        pending.append("serving_tokens_per_sec")
 
     if not args.distributed and not args.cpu:
         if ensure_backend(pending) is None:
@@ -1404,6 +1503,21 @@ def main():
             if line is not None:
                 emit(line)
             pending.pop(0)
+
+    if args.serving:
+        deadman.arm(args.config_timeout, pending)
+        line = None
+        try:
+            line = _ok_line(run_serving())
+        except Exception as e:  # noqa: BLE001 — one JSON line, always
+            deadman.disarm()
+            _emit_error(f"{type(e).__name__}: {e}",
+                        metric="serving_tokens_per_sec")
+        finally:
+            deadman.disarm()
+        if line is not None:
+            emit(line)
+        pending.pop(0)
 
     if args.distributed and jax.process_count() > 1:
         # Arrive at shutdown together: per-measurement wall clock is not

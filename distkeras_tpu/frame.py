@@ -323,18 +323,32 @@ def to_spark(df: DataFrame, spark, columns: Sequence[str] | None = None):
     """
     names = list(columns) if columns is not None else df.columns
 
+    def numeric_row(v) -> bool:
+        arr = np.asarray(v)
+        if arr.dtype != object:
+            return np.issubdtype(arr.dtype, np.number) or arr.dtype == bool
+        # object-dtype rows (how _as_column stores ragged vectors): look at
+        # the scalar leaves themselves
+        import numbers
+
+        return all(isinstance(x, numbers.Number) for x in arr.ravel())
+
     def pyify(name):
         col = df.column(name)
         if col.dtype == object or col.ndim > 1:
-            try:
+            # Decide numeric-vs-not by inspecting element dtypes, not by
+            # attempting the cast and catching: exception-driven dispatch
+            # would coerce numeric-LOOKING strings ("1.5") to floats, and
+            # one stray string deep in an otherwise-numeric column would
+            # flip every row to the scalar branch mid-stream.
+            if all(numeric_row(v) for v in col):
                 return [np.asarray(v).ravel().astype(float).tolist()
                         for v in col]
-            except (ValueError, TypeError):
-                # non-numeric object column (strings, ids — ubiquitous in
-                # Spark frames): pass the rows through as Python scalars
-                # like the scalar branch does, don't force-cast to float
-                return [v.item() if isinstance(v, np.generic) else v
-                        for v in col]
+            # non-numeric object column (strings, ids — ubiquitous in
+            # Spark frames): pass the rows through as Python scalars
+            # like the scalar branch does, don't force-cast to float
+            return [v.item() if isinstance(v, np.generic) else v
+                    for v in col]
         return col.tolist()
 
     data = {name: pyify(name) for name in names}

@@ -85,6 +85,9 @@ class PunchcardServer:
         self._running = False
         self._sock: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
+        # long-running `serve` jobs: job_id -> Popen (the FIFO runner only
+        # handles run-to-completion scripts; a serving engine never exits)
+        self._serving: Dict[str, subprocess.Popen] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -111,6 +114,8 @@ class PunchcardServer:
             self._threads.append(t)
 
     def stop(self) -> None:
+        for job_id in list(self._serving):
+            self._stop_serving_job(job_id)
         with self._cv:
             self._running = False
             self._cv.notify_all()
@@ -157,11 +162,52 @@ class PunchcardServer:
                     self._queue.append(job_id)
                     self._cv.notify()
                 send_data(conn, {"status": "queued", "job_id": job_id})
+            elif action == "serve":
+                # Host a long-running serving engine as a job: launched
+                # detached (Popen) because the FIFO runner blocks until a
+                # script exits and a serving loop never does.  The script is
+                # expected to build a ServingEngine, install the /generate
+                # endpoint, and block; its flightdeck exporter port is
+                # forced on so the engine is reachable, and discoverable
+                # through the usual discovery-file -> status-verb path.
+                job_id = uuid.uuid4().hex
+                script_path = os.path.join(self.workdir, f"{job_id}.py")
+                with open(script_path, "w") as f:
+                    f.write(msg["script"])
+                job = {"status": "serving", "output": "", "returncode": None,
+                       "metrics": None, "script": msg["script"],
+                       "args": msg.get("args", []), "log_path": None}
+                env, _tel_dir = self._job_env(job_id, job, ensure_http=True)
+                log_path = os.path.join(self.workdir, f"{job_id}.log")
+                job["log_path"] = log_path
+                with open(log_path, "w") as log:
+                    proc = subprocess.Popen(
+                        [sys.executable, script_path, *map(str, job["args"])],
+                        stdout=log, stderr=subprocess.STDOUT,
+                        cwd=self.workdir, env=env,
+                    )
+                with self._cv:
+                    self.jobs[job_id] = job
+                self._serving[job_id] = proc
+                if telemetry.enabled():
+                    telemetry.metrics.gauge(
+                        "punchcard_serving_jobs",
+                        help="serve-verb engines currently hosted",
+                    ).set(len(self._serving))
+                send_data(conn, {"status": "serving", "job_id": job_id})
+            elif action == "stop_serving":
+                job_id = msg.get("job_id", "")
+                if job_id not in self._serving:
+                    send_data(conn, {"status": "unknown"})
+                else:
+                    self._stop_serving_job(job_id)
+                    send_data(conn, {"status": "stopped", "job_id": job_id})
             elif action == "status":
                 job = self.jobs.get(msg.get("job_id", ""))
                 if job is None:
                     send_data(conn, {"status": "unknown"})
                 else:
+                    self._refresh_serving(msg.get("job_id", ""), job)
                     # telemetry_dir / http / last_heartbeat let an operator
                     # find (and scrape) a wedged job without grepping the
                     # daemon log; all None while telemetry is off.
@@ -171,6 +217,9 @@ class PunchcardServer:
                                      "http": self._job_http_address(job),
                                      "last_heartbeat": self._job_heartbeat(job)})
             elif action == "list":
+                for jid, j in list(self.jobs.items()):
+                    if jid in self._serving:
+                        self._refresh_serving(jid, j)
                 send_data(conn, {"status": "ok",
                                  "jobs": {k: v["status"] for k, v in self.jobs.items()}})
             elif action == "metrics":
@@ -203,6 +252,70 @@ class PunchcardServer:
         finally:
             conn.close()
 
+    def _job_env(self, job_id: str, job: dict,
+                 ensure_http: bool = False) -> tuple:
+        """Telemetry environment for a spawned job: its own telemetry
+        subdirectory (so the ``aggregate`` verb can collect snapshots
+        without jobs clobbering each other), the fleet run_id (dktrace
+        merge joins on it), and an ephemeral flightdeck exporter when the
+        daemon itself is scrape-able — or unconditionally for ``serve``
+        jobs (``ensure_http``), whose /generate endpoint lives on it.
+        Returns ``(env, tel_dir)``, both ``None`` when telemetry is off."""
+        if not telemetry.enabled():
+            return None, None
+        tel_dir = os.path.join(self.workdir, "telemetry", job_id)
+        os.makedirs(tel_dir, exist_ok=True)
+        job["telemetry_dir"] = tel_dir
+        env = dict(os.environ, DISTKERAS_TELEMETRY="1",
+                   DISTKERAS_TELEMETRY_DIR=tel_dir,
+                   DISTKERAS_RUN_ID=telemetry.flightdeck.run_id())
+        if ensure_http or telemetry.flightdeck.http_port() is not None:
+            env["DISTKERAS_TELEMETRY_HTTP"] = "0"
+        return env, tel_dir
+
+    def _refresh_serving(self, job_id: str, job: dict) -> None:
+        """Fold a serve job's process state into its status: a serving
+        engine that exited did not finish — it died (or was stopped)."""
+        proc = self._serving.get(job_id)
+        if proc is None or proc.poll() is None:
+            return
+        job["returncode"] = proc.returncode
+        job["status"] = "failed" if proc.returncode else "finished"
+        job["output"] = self._read_log(job)
+        self._serving.pop(job_id, None)
+
+    def _stop_serving_job(self, job_id: str) -> None:
+        proc = self._serving.pop(job_id, None)
+        if proc is None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        job = self.jobs.get(job_id)
+        if job is not None:
+            job["status"] = "stopped"
+            job["returncode"] = proc.returncode
+            job["output"] = self._read_log(job)
+        if telemetry.enabled():
+            telemetry.metrics.gauge(
+                "punchcard_serving_jobs",
+                help="serve-verb engines currently hosted",
+            ).set(len(self._serving))
+
+    @staticmethod
+    def _read_log(job: dict) -> str:
+        path = job.get("log_path")
+        if not path:
+            return job.get("output", "")
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                return fh.read()
+        except OSError:
+            return job.get("output", "")
+
     def _runner_loop(self) -> None:
         while True:
             with self._cv:
@@ -216,25 +329,7 @@ class PunchcardServer:
             script_path = os.path.join(self.workdir, f"{job_id}.py")
             with open(script_path, "w") as f:
                 f.write(job["script"])
-            env = None
-            tel_dir = None
-            if telemetry.enabled():
-                # each job writes telemetry into its own subdirectory so the
-                # daemon can pick up the finished snapshot for fleet
-                # aggregation (the ``aggregate`` verb) without jobs
-                # clobbering each other's files
-                tel_dir = os.path.join(self.workdir, "telemetry", job_id)
-                os.makedirs(tel_dir, exist_ok=True)
-                job["telemetry_dir"] = tel_dir
-                # the fleet run_id rides the env so every job stamps its
-                # trace events with the daemon's id (dktrace merge joins on
-                # it); when the daemon itself is scrape-able, jobs get an
-                # ephemeral exporter too, advertised via their discovery file
-                env = dict(os.environ, DISTKERAS_TELEMETRY="1",
-                           DISTKERAS_TELEMETRY_DIR=tel_dir,
-                           DISTKERAS_RUN_ID=telemetry.flightdeck.run_id())
-                if telemetry.flightdeck.http_port() is not None:
-                    env["DISTKERAS_TELEMETRY_HTTP"] = "0"
+            env, tel_dir = self._job_env(job_id, job)
             try:
                 # the job_run span is dktrace merge's clock-skew anchor: a
                 # job's own trace starts at its process-local perf origin,
@@ -389,6 +484,45 @@ class Job:
         if self.job_id is None:
             raise RuntimeError("job not submitted")
         return self._rpc({"action": "status", "job_id": self.job_id})
+
+    def serve(self) -> str:
+        """Host this client's script as a long-running serving job
+        (``serve`` verb).  The script should build a
+        :class:`distkeras_tpu.serving.ServingEngine`, install the
+        ``/generate`` endpoint, and block; once up, ``status()['http']``
+        is its flightdeck address (serve jobs always get an exporter)."""
+        reply = self._rpc({"action": "serve", "script": self.script,
+                           "args": self.args})
+        if reply.get("status") != "serving":
+            raise RuntimeError(f"serve rejected: {reply}")
+        self.job_id = reply["job_id"]
+        return self.job_id
+
+    def stop_serving(self, job_id: Optional[str] = None) -> dict:
+        """Terminate a serving job (``stop_serving`` verb); defaults to
+        this client's job."""
+        jid = job_id or self.job_id
+        if jid is None:
+            raise RuntimeError("no serving job to stop")
+        return self._rpc({"action": "stop_serving", "job_id": jid})
+
+    def serving_address(self, timeout: float = 30.0,
+                        poll: float = 0.2) -> str:
+        """Block until the serving job's flightdeck exporter is
+        discoverable and return its ``host:port``."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.status()
+            if st.get("status") not in ("serving",):
+                raise RuntimeError(f"serving job is {st.get('status')}: "
+                                   f"{st.get('output', '')[-2000:]}")
+            addr = st.get("http")
+            if addr:
+                return addr
+            time.sleep(poll)
+        raise TimeoutError(f"serving job {self.job_id} published no address")
 
     def metrics(self, job_id: Optional[str] = None) -> dict:
         """Scrape the daemon's telemetry registry (``metrics`` verb):

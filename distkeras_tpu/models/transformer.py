@@ -183,6 +183,34 @@ class TransformerLM(nn.Module):
         )
         return nn.Dense(self.vocab_size, name="lm_head")(x)
 
+    def decode_spec(self, params):
+        """Slice ``params`` into the layout the serving engine consumes
+        (:mod:`distkeras_tpu.serving.engine`): embedding tables, per-block
+        subtrees, final LayerNorm, LM head, plus static config.  Kept next
+        to the model so the serving layer cannot drift from the param tree
+        this module actually builds."""
+        if self.seq_axis is not None:
+            raise ValueError(
+                "serving decodes on the single-device twin — build the "
+                "engine from a seq_axis=None model with the same params"
+            )
+        return {
+            "config": {
+                "dim": self.dim, "heads": self.heads,
+                "num_layers": self.num_layers, "max_len": self.max_len,
+                "vocab_size": self.vocab_size,
+                # blocks and the final LayerNorm both use the flax default
+                "ln_eps": 1e-6,
+            },
+            "embed": {
+                "tok": params["tok_embed"]["embedding"],
+                "pos": params["pos_embed"]["embedding"],
+            },
+            "blocks": [params[f"block_{i}"] for i in range(self.num_layers)],
+            "final_ln": params["LayerNorm_0"],
+            "head": params["lm_head"],
+        }
+
 
 class TransformerClassifier(nn.Module):
     """Token classifier over [batch, seq(block)] int32 inputs.

@@ -42,7 +42,7 @@ class ModelPredictor(Predictor):
 
     def __init__(
         self,
-        keras_model: Any,
+        keras_model: Any = None,
         features_col: str = "features",
         output_col: str = "prediction",
         batch_size: int = 512,
@@ -50,10 +50,25 @@ class ModelPredictor(Predictor):
         state: Any = None,
         num_devices: Optional[int] = None,
         distribute_threshold: Optional[int] = None,
+        engine: Any = None,
+        max_new_tokens: int = 16,
     ):
         self.features_col = features_col
         self.output_col = output_col
         self.batch_size = int(batch_size)
+        # Route rows through a serving.ServingEngine instead of the batched
+        # forward pass: each row is a token-id prompt, the prediction column
+        # holds the generated continuation.  The engine carries the model,
+        # so no adapter/mesh setup happens on this path.
+        self.engine = engine
+        self.max_new_tokens = int(max_new_tokens)
+        if engine is not None:
+            self.adapter = None
+            self.params = self.state = None
+            self.last_mode = None
+            return
+        if keras_model is None:
+            raise TypeError("ModelPredictor needs a model (or an engine=)")
         if isinstance(keras_model, TrainedModel):
             self.adapter = keras_model.adapter
             self.params = keras_model.params
@@ -109,7 +124,51 @@ class ModelPredictor(Predictor):
         """Device-put one global batch split over the workers mesh axis."""
         return jax.device_put(chunk, self._shard)
 
+    def _predict_via_engine(self, dataframe: DataFrame) -> DataFrame:
+        """Generation-shaped prediction: every row's features are a token-id
+        prompt submitted to the serving engine.  Submission is windowed —
+        on backpressure (QueueFull) the oldest in-flight request is drained
+        first, so the predictor never overruns the engine's queue and never
+        deadlocks on its own submissions."""
+        from collections import deque
+
+        from distkeras_tpu.serving.frontend import GenerateRequest, QueueFull
+
+        col = dataframe.column(self.features_col)
+        if col.dtype == object:
+            prompts = [[int(t) for t in np.ravel(row)] for row in col]
+        else:
+            prompts = [[int(t) for t in row] for row in np.atleast_2d(
+                dataframe.matrix(self.features_col, dtype=np.int32))]
+        n = len(prompts)
+        out = np.empty(n, dtype=object)
+        in_flight: deque = deque()
+
+        def drain_one():
+            idx, pending = in_flight.popleft()
+            result = pending.result(timeout=300.0)
+            if result is None:
+                raise TimeoutError(f"engine never finished row {idx}")
+            out[idx] = result.tokens
+
+        with telemetry.trace.span("predict", rows=int(n), mode="engine"):
+            for idx, prompt in enumerate(prompts):
+                req = GenerateRequest(prompt=prompt,
+                                      max_new_tokens=self.max_new_tokens)
+                while True:
+                    try:
+                        in_flight.append((idx, self.engine.submit(req)))
+                        break
+                    except QueueFull:
+                        drain_one()
+            while in_flight:
+                drain_one()
+        self.last_mode = "engine"
+        return dataframe.with_column(self.output_col, out)
+
     def predict(self, dataframe: DataFrame) -> DataFrame:
+        if self.engine is not None:
+            return self._predict_via_engine(dataframe)
         col = dataframe.column(self.features_col)
         feats = dataframe.matrix(
             self.features_col,

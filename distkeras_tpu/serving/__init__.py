@@ -1,0 +1,50 @@
+"""Online inference: continuous batching, paged KV cache, SLO metrics.
+
+The request-level serving layer ROADMAP item 1 calls for — everything the
+training side can only do call-at-a-time (``greedy_generate``) reshaped for
+a service that admits requests whenever they arrive:
+
+* :class:`~distkeras_tpu.serving.engine.ServingEngine` — the decode loop
+  (fixed slot ring, ONE jitted step, prefill-on-admission / retire-on-EOS);
+* :mod:`~distkeras_tpu.serving.cache` — paged KV cache (slot page tables
+  over shared K/V pools);
+* :mod:`~distkeras_tpu.serving.sampling` — temperature / top-k / top-p
+  with per-request seeds, all traced (no recompiles);
+* :mod:`~distkeras_tpu.serving.frontend` — request/response dataclasses,
+  bounded queue with backpressure, the flightdeck ``/generate`` endpoint.
+
+Serve over HTTP (flightdeck exporter carries the endpoint)::
+
+    from distkeras_tpu import serving
+    engine = serving.ServingEngine(trained_model)
+    serving.install_http_endpoint(engine)      # POST/GET /generate
+    # SLO histograms (serving_ttft_seconds, serving_token_latency_seconds,
+    # serving_queue_depth, ...) appear on the same server's /metrics.
+
+or as a daemon job: ``PunchcardServer``'s ``serve`` verb
+(:mod:`distkeras_tpu.job_deployment`).
+"""
+
+from distkeras_tpu.serving.cache import PagedKVCache
+from distkeras_tpu.serving.engine import ServingEngine, serving_metrics
+from distkeras_tpu.serving.frontend import (
+    GenerateRequest,
+    GenerateResult,
+    QueueFull,
+    RequestQueue,
+    install_http_endpoint,
+)
+from distkeras_tpu.serving.sampling import sample_one, sample_tokens
+
+__all__ = [
+    "GenerateRequest",
+    "GenerateResult",
+    "PagedKVCache",
+    "QueueFull",
+    "RequestQueue",
+    "ServingEngine",
+    "install_http_endpoint",
+    "sample_one",
+    "sample_tokens",
+    "serving_metrics",
+]

@@ -1,0 +1,118 @@
+"""Paged KV cache for the serving engine.
+
+The training/generation caches (``TransformerLM``'s per-block
+``cached_key``/``cached_value`` buffers, ``StagedLM.init_cache``) are
+*request-shaped*: one contiguous ``[batch, max_len, heads, head_dim]``
+buffer per request batch, allocated for the worst case and thrown away when
+the generate call returns.  A serving engine admitting and retiring requests
+mid-flight needs the vLLM formulation instead: K/V live in fixed **pools of
+pages** shared by every slot, and each slot owns a small *page table* mapping
+its logical context chunks to physical pages.  Admission allocates pages,
+retirement frees them — the pools themselves never change shape, so the
+jitted decode step compiles exactly once.
+
+Layout::
+
+    k_pages, v_pages : [num_layers, num_pages, page_size, heads, head_dim]
+    tables           : [num_slots, pages_per_slot] int32 (host, numpy)
+
+Physical page 0 is a reserved **scratch page**: unallocated table entries
+and inactive slots point at it, so masked-off lanes of the decode step write
+garbage there instead of corrupting live pages.  Attention masks by position
+(``key_pos <= pos``), so scratch garbage is never read.
+
+The pools are plain jax arrays owned by the engine (donated through its jit
+step and reassigned from its outputs); this class owns the *bookkeeping*:
+free-list, per-slot tables, alloc/free.  Host-side only — nothing here is
+traced.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    """Page-table bookkeeping plus the pooled K/V buffers.
+
+    ``pages_per_slot`` rows of the table bound each slot's context to
+    ``pages_per_slot * page_size`` tokens; ``num_pages`` bounds the fleet of
+    pages (default: enough for every slot at full context, plus the scratch
+    page — i.e. no over-subscription unless the caller asks for it).
+    """
+
+    def __init__(self, *, num_layers, num_slots, page_size, pages_per_slot,
+                 heads, head_dim, num_pages=None, dtype=jnp.float32):
+        if page_size < 1 or pages_per_slot < 1 or num_slots < 1:
+            raise ValueError("page_size, pages_per_slot, num_slots must be >= 1")
+        self.num_layers = int(num_layers)
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.pages_per_slot = int(pages_per_slot)
+        if num_pages is None:
+            num_pages = num_slots * pages_per_slot + 1  # +1 scratch
+        if num_pages < 2:
+            raise ValueError("need at least one real page beyond scratch")
+        self.num_pages = int(num_pages)
+        shape = (self.num_layers, self.num_pages, self.page_size,
+                 int(heads), int(head_dim))
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+        # host-side: table rows point at scratch (page 0) until allocated
+        self.tables = np.zeros((self.num_slots, self.pages_per_slot), np.int32)
+        # LIFO free list over physical pages 1..num_pages-1 (0 = scratch)
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._owned = {s: [] for s in range(self.num_slots)}
+
+    # ------------------------------------------------------------- queries
+
+    def pages_needed(self, length: int) -> int:
+        """Pages required to hold ``length`` tokens of context."""
+        return -(-int(length) // self.page_size)  # ceil div
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def max_context(self) -> int:
+        """Tokens a single slot can hold: its table rows times page size."""
+        return self.pages_per_slot * self.page_size
+
+    # ------------------------------------------------------- alloc / free
+
+    def alloc(self, slot: int, n: int) -> None:
+        """Give ``slot`` ``n`` physical pages (admission).  Raises when the
+        pool is dry or the slot's table would overflow — the engine checks
+        :meth:`can_alloc` first, so hitting either is a bookkeeping bug."""
+        owned = self._owned[slot]
+        if len(owned) + n > self.pages_per_slot:
+            raise ValueError(
+                f"slot {slot}: {len(owned)}+{n} pages exceeds table size "
+                f"{self.pages_per_slot}"
+            )
+        if n > len(self._free):
+            raise ValueError(f"page pool dry: want {n}, have {len(self._free)}")
+        for _ in range(n):
+            page = self._free.pop()
+            self.tables[slot, len(owned)] = page
+            owned.append(page)
+
+    def free(self, slot: int) -> int:
+        """Return every page ``slot`` owns to the pool (retirement); the
+        slot's table rows point back at scratch.  Returns the count freed."""
+        owned = self._owned[slot]
+        n = len(owned)
+        while owned:
+            self._free.append(owned.pop())
+        self.tables[slot, :] = 0
+        return n

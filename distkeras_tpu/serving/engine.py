@@ -1,0 +1,616 @@
+"""Continuous-batching serving engine: one jitted decode step, forever.
+
+The generation story before this module was *call-shaped*:
+``greedy_generate`` compiles one program per ``(model, steps)`` and runs a
+whole batch in lockstep — every sequence starts together, finishes together,
+and the program is torn through per call.  An online service sees none of
+that structure: requests arrive whenever, want different lengths and
+sampling, and must not pay a compile.  This engine is the standard
+continuous-batching formulation (Orca/vLLM):
+
+* a fixed ring of ``num_slots`` **batch slots**;
+* ONE jitted single-token **decode step** over all slots, compiled once at
+  construction — every per-request quantity (position, last token, RNG key,
+  temperature/top-k/top-p, active flag) is *data*, so admitting or retiring
+  a request never retraces (dklint DK102);
+* a **paged KV cache** (:mod:`distkeras_tpu.serving.cache`): K/V pools
+  shared by all slots, per-slot page tables, pages allocated at admission
+  and freed at retirement;
+* between decode steps the host loop **admits** queued requests into free
+  slots (prefill) and **retires** finished ones (EOS / max-new-tokens), so
+  a long request never convoys short ones;
+* SLO metrics through the telemetry registry — TTFT and per-token-latency
+  histograms, queue depth, token/request counters — visible on the
+  flightdeck ``/metrics`` scrape.
+
+Numerics: the engine re-runs the model's own flax submodules
+(``nn.LayerNorm`` / ``nn.DenseGeneral`` / ``nn.Dense`` / the
+``_decode_attention`` masking math) over param subtrees sliced out by the
+model's ``decode_spec`` hook, so greedy requests emit tokens **bitwise
+identical** to ``greedy_generate`` (tests/test_serving.py pins this under
+staggered concurrent arrival).  Prefill pads the prompt to the slot's full
+page capacity — positions past the prompt are causally masked and their
+cache rows are overwritten by decode before ever becoming visible, so
+padding changes nothing but FLOPs.  (A production build would bucket
+prefill widths; one width keeps this engine at exactly two programs.)
+
+RNG: each request carries its own ``PRNGKey(seed)`` chain, split once per
+token *of that request* — sampled output is a function of (params, prompt,
+knobs, seed) alone, independent of whatever else shares the batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.sanitizer import lockwatch
+from distkeras_tpu.serving.cache import PagedKVCache
+from distkeras_tpu.serving.frontend import (
+    GenerateRequest,
+    GenerateResult,
+    RequestQueue,
+)
+from distkeras_tpu.serving.sampling import sample_one, sample_tokens
+
+__all__ = ["ServingEngine", "serving_metrics"]
+
+
+def serving_metrics(registry=None) -> dict:
+    """Get-or-create the engine's SLO instruments on ``registry`` (default:
+    the process-global one).  One canonical home for the names/help text so
+    the engine, the golden test, and the CI smoke assert the same schema."""
+    if registry is None:
+        from distkeras_tpu.telemetry.metrics import metrics as registry
+    return {
+        "ttft": registry.histogram(
+            "serving_ttft_seconds",
+            help="time from request admission-queue entry to first token",
+        ),
+        "token_latency": registry.histogram(
+            "serving_token_latency_seconds",
+            help="wall time of one continuous-batching decode step",
+        ),
+        "queue_depth": registry.gauge(
+            "serving_queue_depth", help="requests waiting for a batch slot"
+        ),
+        "active_slots": registry.gauge(
+            "serving_active_slots", help="batch slots generating right now"
+        ),
+        "pages_in_use": registry.gauge(
+            "serving_kv_pages_in_use", help="allocated KV cache pages"
+        ),
+        "tokens": registry.counter(
+            "serving_tokens_total", help="tokens generated across all requests"
+        ),
+        "requests": registry.counter(
+            "serving_requests_total", help="requests completed (any finish reason)"
+        ),
+        "rejected": registry.counter(
+            "serving_requests_rejected_total",
+            help="requests shed by queue backpressure",
+        ),
+    }
+
+
+# ------------------------------------------------------------ model slicing
+
+
+@dataclasses.dataclass
+class _Spec:
+    """Normalized decode view of one causal LM: embedding tables, per-block
+    param subtrees, final LN + head, and the static config the step
+    functions close over.  Built from the model's ``decode_spec`` hook."""
+
+    tok: Any
+    pos: Any
+    blocks: List[Any]
+    final_ln: Any
+    head: Any
+    dim: int
+    heads: int
+    head_dim: int
+    max_len: int
+    vocab: int
+    ln_eps: float
+
+    def params(self) -> dict:
+        """The pytree passed (not closed over) to the jitted steps, so big
+        leaves ride as runtime buffers rather than baked constants."""
+        return {
+            "tok": self.tok, "pos": self.pos, "blocks": list(self.blocks),
+            "final_ln": self.final_ln, "head": self.head,
+        }
+
+
+def _resolve_spec(model, params) -> _Spec:
+    """Accept a ``TrainedModel``, a ``FlaxModel`` adapter + params, or a raw
+    module/adapter with a ``decode_spec`` hook + params."""
+    from distkeras_tpu.models.adapter import FlaxModel, TrainedModel
+
+    if isinstance(model, TrainedModel):
+        return _resolve_spec(model.adapter, model.params)
+    if isinstance(model, FlaxModel):
+        model = model.module
+    hook = getattr(model, "decode_spec", None)
+    if hook is None:
+        raise TypeError(
+            f"{type(model).__name__} has no decode_spec hook; serving "
+            "supports TransformerLM and StagedLM"
+        )
+    if params is None:
+        raise ValueError(
+            "params required when passing a bare module/adapter "
+            "(a TrainedModel carries its own)"
+        )
+    raw = hook(params)
+    cfg = raw["config"]
+    qkv = raw["blocks"][0]["_SelfAttention_0"]["qkv"]["kernel"]
+    return _Spec(
+        tok=jnp.asarray(raw["embed"]["tok"]),
+        pos=jnp.asarray(raw["embed"]["pos"]),
+        blocks=list(raw["blocks"]),
+        final_ln=raw["final_ln"],
+        head=raw["head"],
+        dim=int(cfg["dim"]),
+        heads=int(qkv.shape[-2]),
+        head_dim=int(qkv.shape[-1]),
+        max_len=int(cfg["max_len"]),
+        vocab=int(cfg["vocab_size"]),
+        ln_eps=float(cfg["ln_eps"]),
+    )
+
+
+def _block_apply(bp, x, attend, eps):
+    """One encoder block over param subtree ``bp``, reusing the model's own
+    flax submodules so the math is bit-identical to training/`generate`.
+    ``attend(q, k, v)`` supplies the paged-cache attention."""
+    ap = bp["_SelfAttention_0"]
+    dim = bp["Dense_1"]["kernel"].shape[-1]
+    mlp = bp["Dense_0"]["kernel"].shape[-1]
+    heads, head_dim = ap["qkv"]["kernel"].shape[-2:]
+    h = nn.LayerNorm(epsilon=eps).apply({"params": bp["LayerNorm_0"]}, x)
+    qkv = nn.DenseGeneral((3, heads, head_dim)).apply({"params": ap["qkv"]}, h)
+    q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+    out = attend(q, k, v)
+    h = nn.DenseGeneral(dim, axis=(-2, -1)).apply({"params": ap["proj"]}, out)
+    x = x + h
+    h = nn.LayerNorm(epsilon=eps).apply({"params": bp["LayerNorm_1"]}, x)
+    h = nn.Dense(mlp).apply({"params": bp["Dense_0"]}, h)
+    h = nn.gelu(h)
+    h = nn.Dense(dim).apply({"params": bp["Dense_1"]}, h)
+    return x + h
+
+
+def _head_apply(final_ln, head, x, eps):
+    h = nn.LayerNorm(epsilon=eps).apply({"params": final_ln}, x)
+    return nn.Dense(head["kernel"].shape[-1]).apply({"params": head}, h)
+
+
+# -------------------------------------------------------------- bookkeeping
+
+
+class _Pending:
+    """Handle returned by :meth:`ServingEngine.submit` — resolves to a
+    :class:`GenerateResult` when the request retires."""
+
+    __slots__ = ("request", "max_new", "enqueue_t", "_event", "_result")
+
+    def __init__(self, request: GenerateRequest, max_new: int, enqueue_t: float):
+        self.request = request
+        self.max_new = max_new
+        self.enqueue_t = enqueue_t
+        self._event = threading.Event()
+        self._result: Optional[GenerateResult] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Optional[GenerateResult]:
+        """Block for the result; ``None`` on timeout."""
+        if not self._event.wait(timeout):
+            return None
+        return self._result
+
+    def _resolve(self, result: GenerateResult) -> None:
+        self._result = result
+        self._event.set()
+
+
+class _SlotState:
+    """Host-side record for one occupied batch slot."""
+
+    __slots__ = ("pending", "tokens", "plen", "ttft_s")
+
+    def __init__(self, pending: _Pending, plen: int):
+        self.pending = pending
+        self.tokens: List[int] = []
+        self.plen = plen
+        self.ttft_s = 0.0
+
+
+# -------------------------------------------------------------------- engine
+
+
+class ServingEngine:
+    """Online inference engine with continuous batching over a paged KV
+    cache.  See the module docstring for the design; quick start::
+
+        engine = ServingEngine(trained_model, num_slots=4, page_size=16)
+        out = engine.generate([1, 2, 3], max_new_tokens=8)   # blocking
+        pending = engine.submit(GenerateRequest(prompt=[1, 2, 3]))  # async
+        result = pending.result(timeout=30)
+        engine.stop()
+
+    The host loop runs on a daemon thread started lazily by the first
+    ``submit``/``generate`` (or explicitly via :meth:`start`).  ``model``
+    is a ``TrainedModel``, or a ``TransformerLM``/``StagedLM`` (raw or
+    behind ``FlaxModel``) plus ``params``.
+    """
+
+    def __init__(self, model, params=None, *, num_slots: int = 4,
+                 page_size: int = 16, pages_per_slot: Optional[int] = None,
+                 num_pages: Optional[int] = None, queue_size: int = 64,
+                 registry=None, dtype=jnp.float32):
+        self._spec = _resolve_spec(model, params)
+        spec = self._spec
+        if pages_per_slot is None:
+            pages_per_slot = -(-spec.max_len // page_size)
+        self.num_slots = int(num_slots)
+        self._cache = PagedKVCache(
+            num_layers=len(spec.blocks), num_slots=num_slots,
+            page_size=page_size, pages_per_slot=pages_per_slot,
+            heads=spec.heads, head_dim=spec.head_dim,
+            num_pages=num_pages, dtype=dtype,
+        )
+        # one prefill width = the slot's whole page capacity (see module doc)
+        self._width = self._cache.max_context()
+        self._queue = RequestQueue(queue_size)
+        self._metrics = serving_metrics(registry)
+
+        s = self.num_slots
+        self._slots: List[Optional[_SlotState]] = [None] * s
+        self._pos = np.zeros(s, np.int32)        # position of the fed token
+        self._last = np.zeros(s, np.int32)       # token being fed this step
+        self._keys = np.zeros((s, 2), np.uint32)
+        self._temp = np.zeros(s, np.float32)
+        self._topk = np.zeros(s, np.int32)
+        self._topp = np.ones(s, np.float32)
+        self._active = np.zeros(s, bool)
+
+        self._cv = lockwatch.maybe_wrap(threading.Condition(), "serving.engine")
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+        # Both programs compile exactly once, here — never per request
+        # (the retrace pin in tests/test_serving.py counts on it).
+        self._prefill = jax.jit(self._build_prefill(), donate_argnums=(1, 2))
+        self._decode = jax.jit(self._build_decode(), donate_argnums=(1, 2))
+
+    # ------------------------------------------------------- traced programs
+
+    def _build_prefill(self):
+        spec, cache = self._spec, self._cache
+        ps, pps, width = cache.page_size, cache.pages_per_slot, self._width
+        heads, head_dim, eps = spec.heads, spec.head_dim, spec.ln_eps
+
+        def prefill(params, kpool, vpool, tokens, table, length, key,
+                    temp, top_k, top_p):
+            # tokens [1, width] right-padded; table [pps]; length traced.
+            positions = jnp.clip(jnp.arange(width), 0, spec.max_len - 1)
+            x = params["tok"][tokens] + params["pos"][positions][None]
+            pools = {"k": kpool, "v": vpool}
+
+            def paged_attend(li):
+                def attend(q, k, v):
+                    # stash the whole padded chunk into this slot's pages;
+                    # rows past `length` land on scratch/overwritten pages
+                    # and are causally masked below — never attended.
+                    kc = k[0].reshape(pps, ps, heads, head_dim)
+                    vc = v[0].reshape(pps, ps, heads, head_dim)
+                    pools["k"] = pools["k"].at[li, table].set(kc)
+                    pools["v"] = pools["v"].at[li, table].set(vc)
+                    # causal attention over the chunk itself (same masking
+                    # math as _SelfAttention._decode_attention)
+                    qt = jnp.moveaxis(q, 1, 2)
+                    kt = jnp.moveaxis(k, 1, 2)
+                    vt = jnp.moveaxis(v, 1, 2)
+                    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+                    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+                    q_pos = jnp.arange(width)[:, None]
+                    k_pos = jnp.arange(width)[None, :]
+                    s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+                    out = jnp.einsum(
+                        "bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vt
+                    )
+                    return jnp.moveaxis(out, 1, 2)
+
+                return attend
+
+            for li, bp in enumerate(params["blocks"]):
+                x = _block_apply(bp, x, paged_attend(li), eps)
+            logits = _head_apply(params["final_ln"], params["head"], x, eps)
+            row = jax.lax.dynamic_index_in_dim(
+                logits[0], length - 1, axis=0, keepdims=False
+            )
+            key, sub = jax.random.split(key)
+            tok = sample_one(row, sub, temp, top_k, top_p)
+            return pools["k"], pools["v"], tok, key
+
+        return prefill
+
+    def _build_decode(self):
+        spec, cache = self._spec, self._cache
+        ps, pps = cache.page_size, cache.pages_per_slot
+        s, ctx = self.num_slots, self._width
+        heads, head_dim, eps = spec.heads, spec.head_dim, spec.ln_eps
+
+        def decode(params, kpool, vpool, tables, pos, last, keys,
+                   temp, top_k, top_p, active):
+            # One token for every slot.  Inactive slots compute garbage into
+            # the scratch page (their tables point at physical page 0) and
+            # sample token 0 — all masked out host-side.
+            x = params["tok"][last] + params["pos"][
+                jnp.clip(pos, 0, spec.max_len - 1)
+            ]
+            x = x[:, None, :]  # [slots, 1, dim]
+            pools = {"k": kpool, "v": vpool}
+            slot_ix = jnp.arange(s)
+            phys = tables[slot_ix, jnp.clip(pos // ps, 0, pps - 1)]
+            off = pos % ps
+
+            def paged_attend(li):
+                def attend(q, k, v):
+                    pools["k"] = pools["k"].at[li, phys, off].set(k[:, 0])
+                    pools["v"] = pools["v"].at[li, phys, off].set(v[:, 0])
+                    kg = pools["k"][li][tables].reshape(s, ctx, heads, head_dim)
+                    vg = pools["v"][li][tables].reshape(s, ctx, heads, head_dim)
+                    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+                    sc = jnp.einsum("shd,skhd->shk", q[:, 0], kg) * scale
+                    mask = jnp.arange(ctx)[None, :] <= pos[:, None]
+                    sc = jnp.where(mask[:, None, :], sc, -jnp.inf)
+                    out = jnp.einsum(
+                        "shk,skhd->shd", jax.nn.softmax(sc, axis=-1), vg
+                    )
+                    return out[:, None]
+
+                return attend
+
+            for li, bp in enumerate(params["blocks"]):
+                x = _block_apply(bp, x, paged_attend(li), eps)
+            logits = _head_apply(params["final_ln"], params["head"], x, eps)[:, 0]
+            split = jax.vmap(jax.random.split)(keys)
+            new_keys, subs = split[:, 0], split[:, 1]
+            tok = sample_tokens(logits, subs, temp, top_k, top_p)
+            tok = jnp.where(active, tok, 0)
+            return pools["k"], pools["v"], tok, new_keys
+
+        return decode
+
+    # ----------------------------------------------------------- public API
+
+    def start(self) -> None:
+        """Start the host loop thread (idempotent; ``submit`` calls this)."""
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-engine", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop the loop; queued and in-flight requests resolve with
+        ``finish_reason="aborted"`` (partial tokens included)."""
+        with self._cv:
+            if not self._running:
+                thread = None
+            else:
+                self._running = False
+                thread = self._thread
+                self._thread = None
+            self._cv.notify_all()
+        if thread is not None:
+            thread.join(timeout=timeout)
+        for slot in range(self.num_slots):
+            if self._slots[slot] is not None:
+                self._retire(slot, "aborted")
+        while True:
+            pending = self._queue.pop()
+            if pending is None:
+                break
+            self._finish(pending, [], "aborted", 0.0)
+        self._metrics["queue_depth"].set(0)
+
+    def submit(self, request: GenerateRequest) -> _Pending:
+        """Validate + enqueue; returns a :class:`_Pending` handle.  Raises
+        :class:`~distkeras_tpu.serving.frontend.QueueFull` under
+        backpressure and ``ValueError`` for an unservable request."""
+        request.validate()
+        plen = len(request.prompt)
+        if plen > self._width or plen >= self._spec.max_len:
+            raise ValueError(
+                f"prompt length {plen} exceeds serviceable context "
+                f"(width {self._width}, model max_len {self._spec.max_len})"
+            )
+        if int(np.max(request.prompt)) >= self._spec.vocab:
+            raise ValueError("prompt token id out of vocabulary")
+        max_new = min(request.max_new_tokens, self._spec.max_len - plen,
+                      self._width - plen)
+        pending = _Pending(request, max_new, time.perf_counter())
+        try:
+            self._queue.put(pending)
+        except Exception:
+            self._metrics["rejected"].inc()
+            raise
+        self._metrics["queue_depth"].set(len(self._queue))
+        self.start()
+        with self._cv:
+            self._cv.notify_all()
+        return pending
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 timeout: Optional[float] = 60.0,
+                 **knobs) -> GenerateResult:
+        """Blocking convenience: submit one request, wait for its result.
+        ``knobs`` forwards temperature/top_k/top_p/seed/eos_id."""
+        req = GenerateRequest(prompt=[int(t) for t in prompt],
+                              max_new_tokens=max_new_tokens, **knobs)
+        result = self.submit(req).result(timeout=timeout)
+        if result is None:
+            raise TimeoutError(f"generation did not finish in {timeout}s")
+        return result
+
+    def stats(self) -> Dict[str, float]:
+        """Host-side snapshot for bench/debug (not the metrics surface)."""
+        return {
+            "queue_depth": float(len(self._queue)),
+            "active_slots": float(int(self._active.sum())),
+            "pages_in_use": float(self._cache.pages_in_use),
+            "pages_free": float(self._cache.pages_free),
+        }
+
+    # ------------------------------------------------------------ host loop
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if not self._running:
+                    return
+            progressed = self._admit()
+            progressed = self._decode_once() or progressed
+            if not progressed:
+                with self._cv:
+                    if self._running and len(self._queue) == 0:
+                        self._cv.wait(timeout=0.05)
+
+    def _admit(self) -> bool:
+        """Move queued requests into free slots (prefill).  FIFO with
+        head-of-line blocking: when the page pool can't fit the next
+        request yet, it waits for a retirement rather than being skipped —
+        no starvation of big requests."""
+        admitted = False
+        while True:
+            free = [i for i, st in enumerate(self._slots) if st is None]
+            if not free:
+                break
+            pending = self._queue.pop()
+            if pending is None:
+                break
+            need = self._cache.pages_needed(
+                len(pending.request.prompt) + pending.max_new
+            )
+            if not self._cache.can_alloc(need):
+                self._queue.requeue_front(pending)
+                break
+            self._prefill_into(free[0], pending, need)
+            admitted = True
+        self._metrics["queue_depth"].set(len(self._queue))
+        return admitted
+
+    def _prefill_into(self, slot: int, pending: _Pending, need: int) -> None:
+        req = pending.request
+        plen = len(req.prompt)
+        self._cache.alloc(slot, need)
+        tokens = np.zeros((1, self._width), np.int32)
+        tokens[0, :plen] = req.prompt
+        kp, vp, tok, key = self._prefill(
+            self._spec.params(), self._cache.k_pages, self._cache.v_pages,
+            jnp.asarray(tokens), jnp.asarray(self._cache.tables[slot]),
+            jnp.int32(plen), jax.random.PRNGKey(req.seed),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p),
+        )
+        self._cache.k_pages, self._cache.v_pages = kp, vp
+        tok0 = int(np.asarray(tok))
+        now = time.perf_counter()
+
+        state = _SlotState(pending, plen)
+        state.tokens.append(tok0)
+        state.ttft_s = now - pending.enqueue_t
+        self._metrics["ttft"].observe(state.ttft_s)
+        self._metrics["tokens"].inc()
+        self._slots[slot] = state
+        self._pos[slot] = plen
+        self._last[slot] = tok0
+        self._keys[slot] = np.asarray(key)
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        self._active[slot] = True
+        self._refresh_gauges()
+
+        if req.eos_id is not None and tok0 == req.eos_id:
+            self._retire(slot, "eos")
+        elif len(state.tokens) >= pending.max_new:
+            self._retire(slot, "length")
+
+    def _decode_once(self) -> bool:
+        """One continuous-batching decode step over every active slot."""
+        if not self._active.any():
+            return False
+        t0 = time.perf_counter()
+        kp, vp, tok, keys = self._decode(
+            self._spec.params(), self._cache.k_pages, self._cache.v_pages,
+            jnp.asarray(self._cache.tables), jnp.asarray(self._pos),
+            jnp.asarray(self._last), jnp.asarray(self._keys),
+            jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._topp), jnp.asarray(self._active),
+        )
+        self._cache.k_pages, self._cache.v_pages = kp, vp
+        toks = np.asarray(tok)          # device sync: the step is done here
+        self._keys = np.array(keys)     # np.array: keep the host copy writable
+        self._metrics["token_latency"].observe(time.perf_counter() - t0)
+
+        for slot in range(self.num_slots):
+            state = self._slots[slot]
+            if state is None or not self._active[slot]:
+                continue
+            t = int(toks[slot])
+            state.tokens.append(t)
+            self._metrics["tokens"].inc()
+            self._pos[slot] += 1
+            self._last[slot] = t
+            eos = state.pending.request.eos_id
+            if eos is not None and t == eos:
+                self._retire(slot, "eos")
+            elif len(state.tokens) >= state.pending.max_new:
+                self._retire(slot, "length")
+        return True
+
+    def _retire(self, slot: int, reason: str) -> None:
+        state = self._slots[slot]
+        self._cache.free(slot)
+        self._slots[slot] = None
+        self._active[slot] = False
+        self._pos[slot] = 0
+        self._last[slot] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
+        self._finish(state.pending, state.tokens, reason, state.ttft_s)
+        self._refresh_gauges()
+
+    def _finish(self, pending: _Pending, tokens: List[int], reason: str,
+                ttft_s: float) -> None:
+        self._metrics["requests"].inc()
+        pending._resolve(GenerateResult(
+            request_id=pending.request.request_id,
+            prompt=list(pending.request.prompt),
+            tokens=list(tokens),
+            finish_reason=reason,
+            ttft_s=ttft_s,
+            latency_s=time.perf_counter() - pending.enqueue_t,
+        ))
+
+    def _refresh_gauges(self) -> None:
+        self._metrics["active_slots"].set(int(self._active.sum()))
+        self._metrics["pages_in_use"].set(self._cache.pages_in_use)

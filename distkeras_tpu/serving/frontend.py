@@ -1,0 +1,184 @@
+"""Admission layer for the serving engine: requests, queueing, HTTP.
+
+Three pieces, all host-side:
+
+* :class:`GenerateRequest` / :class:`GenerateResult` — the wire-shaped
+  request/response dataclasses (sampling knobs, per-request seed, EOS id).
+* :class:`RequestQueue` — a bounded queue with **backpressure rejection**:
+  ``put`` raises :class:`QueueFull` instead of blocking, so an overloaded
+  engine sheds load at admission (the HTTP layer maps it to 503) rather
+  than stacking unbounded latency.
+* :func:`install_http_endpoint` — mounts ``/generate`` on the flightdeck
+  exporter via :func:`telemetry.flightdeck.add_endpoint`, accepting GET
+  query parameters or a POST JSON body.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from collections import deque
+from typing import List, Optional
+from urllib.parse import parse_qs
+
+__all__ = [
+    "GenerateRequest",
+    "GenerateResult",
+    "QueueFull",
+    "RequestQueue",
+    "install_http_endpoint",
+]
+
+
+class QueueFull(Exception):
+    """Raised by :meth:`RequestQueue.put` when the queue is at capacity —
+    the backpressure signal (HTTP layer: 503)."""
+
+
+@dataclasses.dataclass
+class GenerateRequest:
+    """One generation request.
+
+    ``temperature <= 0`` (default) means greedy decode; ``seed`` fixes the
+    sampling RNG chain so a request's tokens are deterministic regardless
+    of what else shares the batch; ``eos_id`` retires the request early
+    when that token is emitted.
+    """
+
+    prompt: List[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    request_id: str = ""
+
+    def validate(self) -> None:
+        if not self.prompt:
+            raise ValueError("prompt must be non-empty")
+        if any(int(t) < 0 for t in self.prompt):
+            raise ValueError("prompt token ids must be >= 0")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not (0.0 <= self.top_p <= 1.0) and self.top_p != 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {self.top_p}")
+
+
+@dataclasses.dataclass
+class GenerateResult:
+    """Engine output for one request.  ``tokens`` excludes the prompt;
+    ``finish_reason`` is ``"eos"``, ``"length"``, or ``"aborted"`` (engine
+    stopped with the request in flight)."""
+
+    request_id: str
+    prompt: List[int]
+    tokens: List[int]
+    finish_reason: str
+    ttft_s: float = 0.0
+    latency_s: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+class RequestQueue:
+    """Bounded FIFO with reject-on-full semantics.
+
+    The engine's admission loop is the single consumer; any thread may
+    produce.  ``put`` never blocks — a full queue is an *error* the caller
+    must surface (backpressure), not a wait."""
+
+    def __init__(self, maxsize: int = 64):
+        if maxsize < 1:
+            raise ValueError("maxsize must be >= 1")
+        self.maxsize = int(maxsize)
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def put(self, item) -> None:
+        with self._lock:
+            if len(self._items) >= self.maxsize:
+                raise QueueFull(
+                    f"serving queue at capacity ({self.maxsize}); retry later"
+                )
+            self._items.append(item)
+
+    def pop(self):
+        """Next item or ``None`` when empty (engine loop polls between
+        decode steps; it never blocks on the queue)."""
+        with self._lock:
+            if not self._items:
+                return None
+            return self._items.popleft()
+
+    def requeue_front(self, item) -> None:
+        """Put a popped item back at the head — the engine's head-of-line
+        blocking when the page pool can't fit it yet.  May transiently
+        exceed ``maxsize`` by the one in-flight item; that's the popped
+        item returning, not new admission."""
+        with self._lock:
+            self._items.appendleft(item)
+
+
+# ---------------------------------------------------------------- HTTP
+
+
+def _parse_request(request: dict) -> GenerateRequest:
+    """Build a :class:`GenerateRequest` from the flightdeck request dict
+    (``method``/``query``/``body``).  GET: ``prompt=1,2,3&max_new_tokens=8``;
+    POST: the same fields as a JSON object with ``prompt`` a list."""
+    if request.get("method") == "POST":
+        payload = json.loads(request.get("body") or "{}")
+    else:
+        qs = parse_qs(request.get("query") or "")
+        payload = {k: v[-1] for k, v in qs.items()}
+        if "prompt" in payload:
+            payload["prompt"] = [
+                int(t) for t in str(payload["prompt"]).split(",") if t != ""
+            ]
+    req = GenerateRequest(
+        prompt=[int(t) for t in payload.get("prompt", [])],
+        max_new_tokens=int(payload.get("max_new_tokens", 16)),
+        temperature=float(payload.get("temperature", 0.0)),
+        top_k=int(payload.get("top_k", 0)),
+        top_p=float(payload.get("top_p", 1.0)),
+        seed=int(payload.get("seed", 0)),
+        eos_id=(None if payload.get("eos_id") in (None, "", "None")
+                else int(payload["eos_id"])),
+        request_id=str(payload.get("request_id", "")),
+    )
+    req.validate()
+    return req
+
+
+def install_http_endpoint(engine, path: str = "/generate",
+                          timeout: Optional[float] = None) -> str:
+    """Mount a ``/generate`` endpoint for ``engine`` on the flightdeck
+    exporter.  Blocking request/response: the handler thread (flightdeck's
+    ``ThreadingHTTPServer`` runs one per connection) submits and waits for
+    the result.  Returns the mounted path."""
+    from distkeras_tpu.telemetry.flightdeck import server as _server
+
+    def handle(request):
+        try:
+            req = _parse_request(request)
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            body = json.dumps({"error": f"{type(e).__name__}: {e}"})
+            return ("application/json", body, 400)
+        try:
+            pending = engine.submit(req)
+        except QueueFull as e:
+            return ("application/json", json.dumps({"error": str(e)}), 503)
+        result = pending.result(timeout=timeout)
+        if result is None:
+            body = json.dumps({"error": "generation timed out"})
+            return ("application/json", body, 504)
+        return ("application/json", result.to_json(), 200)
+
+    _server.add_endpoint(path, handle)
+    return path

@@ -1,0 +1,65 @@
+"""Sampling beyond greedy: temperature / top-k / top-p, per-request seeds.
+
+Every knob is a **traced scalar**, not a Python value: the serving engine
+runs one jitted decode step for every request mix, so "this request samples
+at temperature 0.8 with top_k 40, that one is greedy" must be data, never a
+recompile (dklint DK102).  Greedy is the ``temperature <= 0`` limit and is
+computed as an exact ``argmax`` — not a low-temperature softmax — so greedy
+requests through the engine are token-identical to ``greedy_generate``.
+
+Conventions (matching the common HF/vLLM semantics):
+
+* ``temperature <= 0`` — greedy (argmax); the other knobs are ignored.
+* ``top_k <= 0`` or ``>= vocab`` — no top-k truncation.
+* ``top_p >= 1`` — no nucleus truncation; the smallest prefix of
+  probability-sorted tokens with cumulative mass ``>= top_p`` is kept
+  (the token that crosses the threshold is always kept).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_one", "sample_tokens"]
+
+
+def sample_one(logits, key, temperature, top_k, top_p):
+    """Sample one token id from ``logits [vocab]``; every argument after
+    ``logits`` is a traced scalar.  Returns an int32 scalar."""
+    vocab = logits.shape[-1]
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # temperature-scaled working copy (guard the traced divide-by-zero even
+    # though the greedy branch wins the final where)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t
+
+    desc = jnp.sort(scaled)[::-1]  # [vocab], descending
+
+    # top-k: keep logits >= the k-th largest; k<=0 or k>=vocab disables
+    k = jnp.clip(top_k, 1, vocab)
+    kth = desc[k - 1]
+    use_k = (top_k > 0) & (top_k < vocab)
+    k_mask = jnp.where(use_k, scaled >= kth, True)
+
+    # top-p over the sorted softmax: keep the smallest prefix with
+    # cumulative mass >= top_p; (cum - p) < top_p keeps the crossing token
+    probs = jax.nn.softmax(desc)
+    cum = jnp.cumsum(probs)
+    keep_sorted = (cum - probs) < top_p  # [vocab] in sorted order
+    # map back by value: the threshold is the smallest kept sorted logit
+    n_keep = jnp.sum(keep_sorted)
+    p_thresh = desc[jnp.clip(n_keep - 1, 0, vocab - 1)]
+    use_p = top_p < 1.0
+    p_mask = jnp.where(use_p, scaled >= p_thresh, True)
+
+    masked = jnp.where(k_mask & p_mask, scaled, -jnp.inf)
+    sampled = jax.random.categorical(key, masked).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy_tok)
+
+
+def sample_tokens(logits, keys, temperature, top_k, top_p):
+    """Vmapped :func:`sample_one` over a slot batch: ``logits [slots,
+    vocab]``, ``keys [slots]`` PRNG keys, per-slot scalar knob arrays."""
+    return jax.vmap(sample_one)(logits, keys, temperature, top_k, top_p)

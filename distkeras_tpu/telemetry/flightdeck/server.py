@@ -60,8 +60,11 @@ _SERVER: Optional[ThreadingHTTPServer] = None
 _THREAD: Optional[threading.Thread] = None
 _LOCK = threading.Lock()
 
-# Extra endpoint registry: path -> () -> (content_type, body).
-_EXTRA: Dict[str, Callable[[], Tuple[str, str]]] = {}
+# Extra endpoint registry: path -> handler.  Zero-arg handlers return
+# (content_type, body); handlers that accept an argument get a request dict
+# {"method", "query", "body"} and may return a (ctype, body, status) triple
+# (how the serving /generate endpoint speaks 400/503).
+_EXTRA: Dict[str, Callable] = {}
 
 
 def http_port() -> Optional[int]:
@@ -136,10 +139,30 @@ def stop() -> None:
         thread.join(timeout=5)
 
 
-def add_endpoint(path: str, fn: Callable[[], Tuple[str, str]]) -> None:
-    """Register an extra GET endpoint: ``fn() -> (content_type, body)``.
-    The daemon mounts its fleet ``/aggregate`` view here."""
+def add_endpoint(path: str, fn: Callable) -> None:
+    """Register an extra endpoint.
+
+    Two handler shapes, told apart by signature:
+
+    * ``fn() -> (content_type, body)`` — read-only GET view (the daemon's
+      fleet ``/aggregate``);
+    * ``fn(request) -> (content_type, body[, status])`` — request-aware:
+      ``request`` is ``{"method": "GET"|"POST", "query": <raw query
+      string>, "body": <decoded POST body or "">}``, and the optional
+      third element sets the HTTP status (the serving ``/generate``
+      endpoint's 400/503/504).  Request-aware endpoints also receive POSTs.
+    """
     _EXTRA[path] = fn
+
+
+def _wants_request(fn: Callable) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return len(sig.parameters) >= 1
 
 
 def _write_discovery_file() -> None:
@@ -166,8 +189,8 @@ def _write_discovery_file() -> None:
 # ------------------------------------------------------------------ handler
 
 
-def _render(path: str) -> Optional[Tuple[str, str]]:
-    """Body for one endpoint, or ``None`` for 404."""
+def _render(path: str, request: Optional[dict] = None) -> Optional[Tuple[str, str, int]]:
+    """``(content_type, body, status)`` for one endpoint, ``None`` for 404."""
     # Lazy: metrics/trace/dynamics import this package for their ring feeds.
     from distkeras_tpu import sanitizer as _sanitizer
     from distkeras_tpu.telemetry import dynamics as _dynamics
@@ -178,7 +201,7 @@ def _render(path: str) -> Optional[Tuple[str, str]]:
     rid = correlate.run_id()
     if path == "/metrics":
         text = _registry.to_prometheus(labels={"run_id": rid})
-        return ("text/plain; version=0.0.4; charset=utf-8", text)
+        return ("text/plain; version=0.0.4; charset=utf-8", text, 200)
     if path == "/healthz":
         counts: Dict[str, int] = {}
         for kind, _msg in _sanitizer.violations():
@@ -194,7 +217,7 @@ def _render(path: str) -> Optional[Tuple[str, str]]:
             "watchdog": rec.watchdog_state(),
             "sanitizer": {"mode": _sanitizer.mode(), "violations": counts},
         }
-        return ("application/json", json.dumps(body))
+        return ("application/json", json.dumps(body), 200)
     if path == "/vars":
         body = {
             "run_id": rid,
@@ -203,13 +226,17 @@ def _render(path: str) -> Optional[Tuple[str, str]]:
             "phase_breakdown": _registry.phase_breakdown(),
             "dynamics": _dynamics.last_summary(),
         }
-        return ("application/json", json.dumps(body))
+        return ("application/json", json.dumps(body), 200)
     if path == "/trace":
         payload = rec.trace_export(origin=_tracer._origin)
-        return ("application/json", json.dumps(payload))
+        return ("application/json", json.dumps(payload), 200)
     fn = _EXTRA.get(path)
     if fn is not None:
-        return fn()
+        out = fn(request or {"method": "GET", "query": "", "body": ""}) \
+            if _wants_request(fn) else fn()
+        if len(out) == 2:
+            return (out[0], out[1], 200)
+        return out
     return None
 
 
@@ -219,10 +246,19 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102 — silence stderr access log
         pass
 
-    def do_GET(self):  # noqa: N802 — http.server API
-        path = self.path.split("?", 1)[0]
+    def _dispatch(self, method: str) -> None:
+        path, _, query = self.path.partition("?")
+        body = ""
+        if method == "POST":
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length).decode("utf-8", "replace")
+            if path not in _EXTRA:
+                self._reply(405, "text/plain",
+                            "POST only supported on registered endpoints")
+                return
+        request = {"method": method, "query": query, "body": body}
         try:
-            payload = _render(path)
+            payload = _render(path, request)
         except Exception as e:  # noqa: BLE001 — a scrape must never kill training
             self._reply(500, "text/plain", f"{type(e).__name__}: {e}")
             return
@@ -230,7 +266,14 @@ class _Handler(BaseHTTPRequestHandler):
             known = ["/metrics", "/healthz", "/vars", "/trace", *sorted(_EXTRA)]
             self._reply(404, "text/plain", "not found; endpoints: " + " ".join(known))
             return
-        self._reply(200, *payload)
+        ctype, text, status = payload
+        self._reply(status, ctype, text)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        self._dispatch("POST")
 
     def _reply(self, code: int, ctype: str, body: str) -> None:
         data = body.encode("utf-8")

@@ -42,6 +42,15 @@ class ScalarLogger:
             # a logging preference.
             if self._try_tf():
                 self._write = self._write_tf
+            else:
+                import warnings
+
+                warnings.warn(
+                    "DISTKERAS_TB_TF is set but tf.summary is not importable;"
+                    " falling back to JSONL scalars in " + self.logdir,
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
 
     def _try_torch(self) -> bool:
         try:
