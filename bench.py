@@ -1184,6 +1184,10 @@ def run_streaming(config: str = HEADLINE, n_windows: int = 8, reps: int = None,
         "compute_only_seconds": round(wall_compute, 3),
         "streaming_seconds": round(wall_stream, 3),
         "unhideable_transfer_seconds": transfer_excess,
+        # the engine's own steady-state verdict (see run_epoch_streaming's
+        # link guardrail): True means the source/link, not compute, bounds
+        # streamed throughput on this host
+        "link_bound": (engine.last_stream_report or {}).get("link_bound"),
         "protocol": "overlap vs host-source + device-compute; transfer "
                     "rides the streaming wall only — on a link slower than "
                     "compute (tunnel) overlap_efficiency goes negative",
@@ -1193,7 +1197,8 @@ def run_streaming(config: str = HEADLINE, n_windows: int = 8, reps: int = None,
 def run_serving(n_requests: int = 64, num_slots: int = 8, page_size: int = 16,
                 max_new_tokens: int = 32, dim: int = 256, heads: int = 8,
                 num_layers: int = 4, max_len: int = 256,
-                vocab: int = 4096) -> dict:
+                vocab: int = 4096, draft_layers: int = 0,
+                spec_tokens: int = 4) -> dict:
     """Online-serving SLO measurement: offered load through the continuous
     batching engine (``distkeras_tpu.serving``), reporting decode
     throughput and the latency quantiles an operator would alert on.
@@ -1203,7 +1208,16 @@ def run_serving(n_requests: int = 64, num_slots: int = 8, page_size: int = 16,
     continuous batching — admissions and retirements interleaved with
     decode steps — not a lockstep batch.  TTFT/token-latency quantiles are
     read back from the same ``serving_*`` histograms flightdeck scrapes,
-    so the bench exercises the exact metrics surface production would."""
+    so the bench exercises the exact metrics surface production would.
+    The prefill/decode phase split and padded-prefill overhead come from
+    the same counters.
+
+    ``draft_layers > 0`` measures the speculative fast path instead: a
+    truncated-depth draft of the same architecture proposes
+    ``spec_tokens``-token windows, and the row adds the acceptance rate
+    (decode_steps_per_token is already < 1 under continuous batching —
+    one engine step feeds every busy slot — and speculation drives it
+    lower still as acceptance rises)."""
     import jax
 
     from distkeras_tpu.models.transformer import TransformerLM
@@ -1215,15 +1229,33 @@ def run_serving(n_requests: int = 64, num_slots: int = 8, page_size: int = 16,
     rng = np.random.RandomState(0)
     params = model.init(jax.random.PRNGKey(0),
                         np.zeros((1, 8), np.int32))["params"]
+    draft_kwargs = {}
+    if draft_layers > 0:
+        draft = TransformerLM(vocab_size=vocab, dim=dim, heads=heads,
+                              num_layers=draft_layers, max_len=max_len)
+        draft_kwargs = {
+            "draft_model": draft,
+            # the draft shares the target's trained early layers in spirit;
+            # for a bench, independently-initialised weights measure the
+            # WORST-case acceptance (uncorrelated draft), which still pins
+            # the mechanics and the counters
+            "draft_params": draft.init(jax.random.PRNGKey(1),
+                                       np.zeros((1, 8), np.int32))["params"],
+            "spec_tokens": spec_tokens,
+        }
     registry = Registry()  # private: a bench must not pollute the scrape
     engine = ServingEngine(model, params, num_slots=num_slots,
                            page_size=page_size, queue_size=num_slots * 4,
-                           registry=registry)
+                           registry=registry, **draft_kwargs)
     prompts = [rng.randint(0, vocab, size=int(n)).tolist()
                for n in rng.randint(4, max_len - max_new_tokens,
                                     size=n_requests)]
-    # warmup: compile prefill + decode outside the timed region
-    engine.generate(prompts[0], max_new_tokens=2, timeout=300.0)
+    # warmup: compile every prefill bucket and the decode (or draft+verify)
+    # programs outside the timed region — a prompt of width-2 tokens lands
+    # exactly in bucket `width`
+    for w in engine.prefill_buckets:
+        engine.generate(rng.randint(0, vocab, size=w - 2).tolist(),
+                        max_new_tokens=2, timeout=300.0)
 
     pending = []
     t0 = time.perf_counter()
@@ -1250,8 +1282,26 @@ def run_serving(n_requests: int = 64, num_slots: int = 8, page_size: int = 16,
 
     ttfts = [r.ttft_s for r in done]
     lats = [r.latency_s for r in done]
-    return {
-        "metric": "serving_tokens_per_sec",
+
+    # Phase split + fast-path counters, from the same registry the
+    # flightdeck scrape would expose (includes the warmup request — the
+    # ratios below are counter-to-counter, so that cancels out).
+    snap = registry.snapshot()
+
+    def _val(name, key="value"):
+        entry = snap.get(name)
+        return None if entry is None else entry.get(key)
+
+    prefill_s = _val("serving_prefill_seconds", "sum")
+    decode_s = _val("serving_token_latency_seconds", "sum")
+    tokens_ctr = _val("serving_tokens_total")
+    steps_ctr = _val("serving_decode_steps_total")
+    padded_ctr = _val("serving_prefill_padded_tokens")
+    proposed = _val("serving_spec_proposed_total")
+    accepted = _val("serving_spec_accepted_total")
+    row = {
+        "metric": ("serving_spec_tokens_per_sec" if draft_layers > 0
+                   else "serving_tokens_per_sec"),
         "value": round(total_tokens / wall, 1) if wall > 0 else None,
         "unit": "generated tokens/sec through continuous batching",
         "vs_baseline": None,
@@ -1261,9 +1311,20 @@ def run_serving(n_requests: int = 64, num_slots: int = 8, page_size: int = 16,
         "ttft_p99_s": q(ttfts, 0.99),
         "request_latency_p50_s": q(lats, 0.50),
         "request_latency_p99_s": q(lats, 0.99),
+        "prefill_seconds": round(prefill_s, 3) if prefill_s else None,
+        "decode_seconds": round(decode_s, 3) if decode_s else None,
+        "prefill_padded_tokens": padded_ctr,
+        "decode_steps_per_token": (
+            round(steps_ctr / tokens_ctr, 4) if tokens_ctr else None),
         "protocol": "closed-loop offered load, mixed prompt lengths, "
                     "greedy sampling; warmup compile excluded",
     }
+    if draft_layers > 0:
+        row["draft_layers"] = draft_layers
+        row["spec_tokens"] = spec_tokens
+        row["spec_acceptance_rate"] = (
+            round(accepted / proposed, 4) if proposed else None)
+    return row
 
 
 def write_baseline(results: dict) -> None:
@@ -1358,6 +1419,7 @@ def main():
         pending.extend(f"{c}_mfu_ceiling" for c in configs)
     if args.serving:
         pending.append("serving_tokens_per_sec")
+        pending.append("serving_spec_tokens_per_sec")
 
     if not args.distributed and not args.cpu:
         if ensure_backend(pending) is None:
@@ -1513,6 +1575,23 @@ def main():
             deadman.disarm()
             _emit_error(f"{type(e).__name__}: {e}",
                         metric="serving_tokens_per_sec")
+        finally:
+            deadman.disarm()
+        if line is not None:
+            emit(line)
+        pending.pop(0)
+
+        # speculative row: same workload through a 1-layer draft of the same
+        # family — acceptance is worst-case (uncorrelated weights) but the
+        # phase split, counters, and steps-per-token mechanics are real
+        deadman.arm(args.config_timeout, pending)
+        line = None
+        try:
+            line = _ok_line(run_serving(draft_layers=1))
+        except Exception as e:  # noqa: BLE001 — one JSON line, always
+            deadman.disarm()
+            _emit_error(f"{type(e).__name__}: {e}",
+                        metric="serving_spec_tokens_per_sec")
         finally:
             deadman.disarm()
         if line is not None:

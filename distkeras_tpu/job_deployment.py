@@ -174,10 +174,19 @@ class PunchcardServer:
                 script_path = os.path.join(self.workdir, f"{job_id}.py")
                 with open(script_path, "w") as f:
                     f.write(msg["script"])
+                flags = msg.get("flags")
                 job = {"status": "serving", "output": "", "returncode": None,
                        "metrics": None, "script": msg["script"],
-                       "args": msg.get("args", []), "log_path": None}
+                       "args": msg.get("args", []), "log_path": None,
+                       "serve_flags": flags if isinstance(flags, dict) else {}}
                 env, _tel_dir = self._job_env(job_id, job, ensure_http=True)
+                if job["serve_flags"]:
+                    # engine knobs (prefill_buckets, spec_tokens, ...) ride
+                    # to the child as JSON; the script reads them back via
+                    # serving.serve_flags() so one script serves many configs
+                    if env is None:  # telemetry off: _job_env built no env
+                        env = dict(os.environ)
+                    env["DISTKERAS_SERVE_FLAGS"] = json.dumps(job["serve_flags"])
                 log_path = os.path.join(self.workdir, f"{job_id}.log")
                 job["log_path"] = log_path
                 with open(log_path, "w") as log:
@@ -215,7 +224,8 @@ class PunchcardServer:
                                      "returncode": job["returncode"],
                                      "telemetry_dir": job.get("telemetry_dir"),
                                      "http": self._job_http_address(job),
-                                     "last_heartbeat": self._job_heartbeat(job)})
+                                     "last_heartbeat": self._job_heartbeat(job),
+                                     "serve_flags": job.get("serve_flags")})
             elif action == "list":
                 for jid, j in list(self.jobs.items()):
                     if jid in self._serving:
@@ -485,14 +495,22 @@ class Job:
             raise RuntimeError("job not submitted")
         return self._rpc({"action": "status", "job_id": self.job_id})
 
-    def serve(self) -> str:
+    def serve(self, flags: Optional[dict] = None) -> str:
         """Host this client's script as a long-running serving job
         (``serve`` verb).  The script should build a
         :class:`distkeras_tpu.serving.ServingEngine`, install the
         ``/generate`` endpoint, and block; once up, ``status()['http']``
-        is its flightdeck address (serve jobs always get an exporter)."""
-        reply = self._rpc({"action": "serve", "script": self.script,
-                           "args": self.args})
+        is its flightdeck address (serve jobs always get an exporter).
+
+        ``flags`` (a JSON-safe dict of engine knobs — ``prefill_buckets``,
+        ``spec_tokens``, ``num_slots``, ...) is delivered to the job as the
+        ``DISTKERAS_SERVE_FLAGS`` env var; the script reads it back with
+        :func:`distkeras_tpu.serving.serve_flags`, so one serving script
+        can be deployed under many engine configurations."""
+        msg = {"action": "serve", "script": self.script, "args": self.args}
+        if flags is not None:
+            msg["flags"] = dict(flags)
+        reply = self._rpc(msg)
         if reply.get("status") != "serving":
             raise RuntimeError(f"serve rejected: {reply}")
         self.job_id = reply["job_id"]

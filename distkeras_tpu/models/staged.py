@@ -266,6 +266,9 @@ class StagedLM(StagedTransformer):
         return {
             "config": {
                 "dim": self.dim, "heads": self.heads,
+                # explicit head geometry for the engine's tensor-parallel
+                # build (global count, independent of kernel sharding)
+                "head_dim": self.dim // self.heads,
                 "num_layers": n_blocks, "max_len": self.max_len,
                 "vocab_size": self.vocab_size, "ln_eps": self.ln_eps,
             },

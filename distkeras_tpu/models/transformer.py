@@ -197,6 +197,10 @@ class TransformerLM(nn.Module):
         return {
             "config": {
                 "dim": self.dim, "heads": self.heads,
+                # explicit head geometry: the engine's tensor-parallel build
+                # shards the qkv kernels over heads, so the global count must
+                # come from config, not from (shard-local) kernel shapes
+                "head_dim": self.dim // self.heads,
                 "num_layers": self.num_layers, "max_len": self.max_len,
                 "vocab_size": self.vocab_size,
                 # blocks and the final LayerNorm both use the flax default

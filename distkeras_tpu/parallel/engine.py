@@ -261,6 +261,10 @@ class WindowedEngine:
         # host-side wrappers — pinned in tests/test_sanitizer.py).
         self._sanitize = sanitizer_mod.enabled()
         self._epoch_fns = {}
+        #: filled by :meth:`run_epoch_streaming`: source/transfer timing and
+        #: the link-bound verdict for the last streamed epoch (bench reads it)
+        self.last_stream_report = None
+        self._link_warned = False
 
     # ------------------------------------------------------------------ init
     def init_state(self, rng: jax.Array, sample_input) -> TrainState:
@@ -1093,7 +1097,8 @@ class WindowedEngine:
             if not (key[0] == "multi" and key[-2:] == tuple(keep_multi)):
                 del self._epoch_fns[key]
 
-    def run_epoch_streaming(self, state: TrainState, window_iter, prefetch: int = 2):
+    def run_epoch_streaming(self, state: TrainState, window_iter,
+                            prefetch: int = 2, strict_link=None):
         """Run one epoch from a host-side iterator of per-window blocks
         ``(xs, ys)`` shaped ``[num_workers, window, batch, ...]`` (see
         :func:`distkeras_tpu.data.epoch_window_iter`).
@@ -1107,13 +1112,30 @@ class WindowedEngine:
         The per-window program is the n_windows=1 epoch program, so the
         training trajectory is the math of :meth:`run_epoch` exactly
         (asserted bit-for-bit in tests/test_streaming.py).
+
+        **Link guardrail**: overlap only *hides* source latency while the
+        source is faster than the device; a link slower than compute makes
+        the accelerators idle every window and no prefetch depth can fix it
+        (PERF.md §8 — the axon-tunnel lesson).  This method times the
+        source pulls it already makes (no extra syncs), and when the
+        steady-state unhideable source fraction exceeds 25% it warns once —
+        or raises when ``strict_link=True`` (default: the
+        ``DISTKERAS_STREAMING_STRICT`` env var).  The measured report is
+        kept on ``self.last_stream_report`` for bench/debug.
         """
         if self.commit_schedule is not None:
             raise ValueError(
                 "streaming runs uniform windows; the staleness simulation "
                 "needs the whole epoch in one program (run_epoch)"
             )
+        import os
+        import time
+        import warnings
         from collections import deque
+
+        if strict_link is None:
+            strict_link = os.environ.get(
+                "DISTKERAS_STREAMING_STRICT", "").lower() not in ("", "0", "false")
 
         # Ship float features pre-cast to the compute dtype: the first thing
         # the local step does with x is cast it (``_local_step``), so casting
@@ -1133,14 +1155,37 @@ class WindowedEngine:
         it = iter(window_iter)
         buf = deque()
         stats_list = []
+        steps_list = []  # per-window step counts (ragged tail weighting)
         n_windows = 0
         depth = max(1, prefetch)
+        # Source/link accounting: time only the pulls the loop already makes
+        # (next(it) + host cast + transfer dispatch) — never an added sync.
+        # Steady state starts after the first backpressure wait completes:
+        # before that, compile + initial prefill dominate and would
+        # misattribute one-time costs to the link.
+        src_seconds = 0.0
+        steady_src = 0.0
+        steady_t0 = None
+
+        def pull():
+            nonlocal src_seconds, steady_src
+            t0 = time.perf_counter()
+            block = next(it, None)
+            if block is not None:
+                steps_list.append(block[0].shape[1])
+                block = put(block)
+            dt = time.perf_counter() - t0
+            src_seconds += dt
+            if steady_t0 is not None:
+                steady_src += dt
+            return block
+
         while True:
             if not buf:
-                block = next(it, None)
+                block = pull()
                 if block is None:
                     break
-                buf.append(put(block))
+                buf.append(block)
             xs, ys = buf.popleft()
             # async dispatch; sync_telemetry=False because blocking here
             # would serialise the pipeline — spans are recorded at the real
@@ -1159,25 +1204,81 @@ class WindowedEngine:
                 with telemetry.trace.span("window_wait", phase="step",
                                           window=n_windows - 1 - depth):
                     jax.block_until_ready(stats_list[n_windows - 1 - depth]["loss"])
+                if steady_t0 is None:
+                    steady_t0 = time.perf_counter()
             # Refill AFTER dispatching (first window included): the very
             # first window's compute then hides the rest of the initial
             # prefill's source latency — measured, not assumed, in
             # tests/test_streaming_overlap.py.
             while len(buf) < depth:
-                block = next(it, None)
+                block = pull()
                 if block is None:
                     break
-                buf.append(put(block))
+                buf.append(block)
         if not stats_list:
             raise ValueError("empty window iterator")
+        self._report_stream_link(src_seconds, steady_src, steady_t0,
+                                 n_windows, strict_link, time.perf_counter())
         # generic over the stats pytree (loss/metrics, plus the dynamics
         # subtree when enabled): concatenate every leaf along the window axis
         stats = jax.tree.map(lambda *leaves: jnp.concatenate(leaves), *stats_list)
+        # per-window step counts ride along as a host leaf so the history
+        # can weight a ragged tail window by its actual steps (PARITY.md)
+        stats = dict(stats)
+        stats["window_steps"] = np.asarray(steps_list, np.int64)
         # each window ran as its own "epoch" program (epoch += n_windows);
         # restore whole-epoch semantics (+1).  The input state was donated by
         # the first window's call, so arithmetic uses the live output state.
         state = state.replace(epoch=state.epoch - (n_windows - 1))
         return state, stats
+
+    def _report_stream_link(self, src_seconds, steady_src, steady_t0,
+                            n_windows, strict_link, now):
+        """Judge the last streamed epoch's source/compute balance.
+
+        Over the steady-state region (first backpressure wait -> epoch end)
+        the loop alternates pulling source blocks and waiting on the device;
+        source time hidden behind compute shows up as wall time NOT spent in
+        pulls, so ``unhideable = steady_src - (steady_wall - steady_src)``
+        is the part of the link cost the device actually waited out.  A
+        fraction > 0.25 of steady wall time means the link, not the model,
+        bounds throughput — warn loudly (once per engine) or raise in
+        strict mode.  Short epochs that never hit backpressure measure
+        nothing and never trip the guardrail."""
+        import warnings
+
+        steady_wall = (now - steady_t0) if steady_t0 is not None else 0.0
+        if steady_wall > 0:
+            hidden = max(0.0, steady_wall - steady_src)
+            unhideable = max(0.0, steady_src - hidden)
+            fraction = unhideable / steady_wall
+        else:
+            unhideable, fraction = 0.0, 0.0
+        link_bound = fraction > 0.25
+        self.last_stream_report = {
+            "windows": n_windows,
+            "source_seconds": src_seconds,
+            "steady_wall_seconds": steady_wall,
+            "steady_source_seconds": steady_src,
+            "unhideable_fraction": fraction,
+            "link_bound": link_bound,
+        }
+        if not link_bound:
+            return
+        msg = (
+            f"streaming source is the bottleneck: {fraction:.0%} of "
+            f"steady-state wall time ({steady_src:.2f}s of "
+            f"{steady_wall:.2f}s over {n_windows} windows) is source/"
+            "transfer latency no prefetch depth can hide — the devices are "
+            "idling on the link.  Stage the dataset closer (local disk / "
+            "in-memory), widen the link, or grow per-window compute "
+            "(larger window/batch).  See engine.last_stream_report."
+        )
+        if strict_link:
+            raise RuntimeError(msg)
+        if not self._link_warned:
+            self._link_warned = True
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
     def average_workers(self, state: TrainState):
         """One-shot synchronous weight average (AveragingTrainer's final step)."""

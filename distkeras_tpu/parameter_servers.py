@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu.algorithms import Adag, Downpour, DynSGD, UpdateRule
@@ -45,6 +46,7 @@ class ParameterServer:
         self.center_params: Any = None
         self.center_model_state: Any = None
         self._num_updates: int = 0
+        self._live_updates: Any = None  # device-side counter copy mid-fit
         self.running = False
 
     # -- lifecycle (reference parity: initialize/start/run/stop) ------------
@@ -70,11 +72,27 @@ class ParameterServer:
         num = center_rule_state.get("num_updates") if isinstance(center_rule_state, dict) else None
         if num is not None:
             self._num_updates = int(np.asarray(num))
+        self._live_updates = None  # final count wins over the mid-fit copy
+
+    def track(self, center_rule_state) -> None:
+        """Called by the trainer at every epoch boundary *while training
+        runs*: snapshot the on-device commit counter so :attr:`num_updates`
+        is pollable live (reference parity — the socket PS could be asked
+        mid-train).  The epoch state is donated into the next epoch's
+        dispatch, so the facade keeps its own ``jnp.copy`` of the counter;
+        the copy is dispatched here (before the donation) and only
+        materialised if someone reads the property."""
+        num = center_rule_state.get("num_updates") if isinstance(center_rule_state, dict) else None
+        if num is not None:
+            self._live_updates = jnp.copy(num)
 
     @property
     def num_updates(self) -> int:
         """Total commits applied to the center variable (reference parity:
-        ``ParameterServer.num_updates``)."""
+        ``ParameterServer.num_updates``).  Live during a fit — epoch
+        boundaries refresh it via :meth:`track`."""
+        if self._live_updates is not None:
+            return int(np.asarray(self._live_updates))
         return self._num_updates
 
     def get_model(self):

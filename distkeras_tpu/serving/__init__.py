@@ -22,10 +22,16 @@ Serve over HTTP (flightdeck exporter carries the endpoint)::
     # serving_queue_depth, ...) appear on the same server's /metrics.
 
 or as a daemon job: ``PunchcardServer``'s ``serve`` verb
-(:mod:`distkeras_tpu.job_deployment`).
+(:mod:`distkeras_tpu.job_deployment`), which forwards engine knobs via
+``Job.serve(flags=...)`` -> :func:`serve_flags`.
+
+Fast paths (all optional engine kwargs): ``prefill_buckets`` — power-of-two
+prefill width ladder; ``draft_model``/``spec_tokens`` — speculative
+decoding with exact accept/resample semantics; ``mesh`` — tensor-parallel
+decode over the local devices.
 """
 
-from distkeras_tpu.serving.cache import PagedKVCache
+from distkeras_tpu.serving.cache import PagedKVCache, append_rows, rollback_rows
 from distkeras_tpu.serving.engine import ServingEngine, serving_metrics
 from distkeras_tpu.serving.frontend import (
     GenerateRequest,
@@ -33,8 +39,14 @@ from distkeras_tpu.serving.frontend import (
     QueueFull,
     RequestQueue,
     install_http_endpoint,
+    serve_flags,
 )
-from distkeras_tpu.serving.sampling import sample_one, sample_tokens
+from distkeras_tpu.serving.sampling import (
+    modified_probs,
+    sample_one,
+    sample_tokens,
+    speculative_verify,
+)
 
 __all__ = [
     "GenerateRequest",
@@ -43,8 +55,13 @@ __all__ = [
     "QueueFull",
     "RequestQueue",
     "ServingEngine",
+    "append_rows",
     "install_http_endpoint",
+    "modified_probs",
+    "rollback_rows",
     "sample_one",
     "sample_tokens",
+    "serve_flags",
     "serving_metrics",
+    "speculative_verify",
 ]

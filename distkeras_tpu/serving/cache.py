@@ -32,7 +32,55 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PagedKVCache"]
+__all__ = ["PagedKVCache", "append_rows", "rollback_rows"]
+
+
+# ------------------------------------------------------- traced pool writes
+#
+# The two functions below are the *traced* companions to the host-side
+# bookkeeping: they scatter token rows into (or out of) the pools through a
+# slot's page table.  ``append_rows`` generalises the decode step's one-row
+# write to the ``m``-row window a speculative verify feeds; ``rollback_rows``
+# erases the rejected suffix of that window so the pools only ever hold
+# accepted-token K/V between engine iterations.
+
+
+def append_rows(pool, layer, tables, pos, rows):
+    """Scatter ``rows [slots, m, heads, head_dim]`` into ``pool`` at logical
+    positions ``pos + 0 .. pos + m-1`` of each slot, through ``tables
+    [slots, pages_per_slot]``.  Positions at or past a slot's capacity
+    (``pages_per_slot * page_size``) are redirected to the scratch page, so
+    a speculative window overhanging the end of context can never clobber
+    another slot's pages — ``max_context`` stays honest."""
+    page_size = pool.shape[2]
+    pages_per_slot = tables.shape[1]
+    m = rows.shape[1]
+    logical = pos[:, None] + jnp.arange(m)[None, :]  # [slots, m]
+    page_ix = jnp.clip(logical // page_size, 0, pages_per_slot - 1)
+    phys = jnp.take_along_axis(tables, page_ix, axis=1)
+    phys = jnp.where(logical < pages_per_slot * page_size, phys, 0)
+    return pool.at[layer, phys, logical % page_size].set(rows)
+
+
+def rollback_rows(pool, layer, tables, pos, count, m):
+    """Zero the rejected suffix of an ``m``-row verify window: rows
+    ``pos + count .. pos + m-1`` of each slot.  Kept rows (and overhang past
+    capacity) are redirected to the scratch page, where the zero-write is
+    harmless.  Defensive hygiene more than correctness: attention masks
+    ``key_pos <= pos`` and every future write window starts at the live
+    position, so stale rows would be overwritten before they could ever be
+    attended — but zeroing them keeps the pools' invariant ("only accepted
+    tokens between iterations") checkable."""
+    page_size = pool.shape[2]
+    pages_per_slot = tables.shape[1]
+    offs = jnp.arange(m)[None, :]
+    logical = pos[:, None] + offs  # [slots, m]
+    rejected = (offs >= count[:, None]) & (logical < pages_per_slot * page_size)
+    page_ix = jnp.clip(logical // page_size, 0, pages_per_slot - 1)
+    phys = jnp.take_along_axis(tables, page_ix, axis=1)
+    phys = jnp.where(rejected, phys, 0)
+    zeros = jnp.zeros((pos.shape[0], m) + pool.shape[3:], pool.dtype)
+    return pool.at[layer, phys, logical % page_size].set(zeros)
 
 
 class PagedKVCache:

@@ -9,10 +9,10 @@ sampling, and must not pay a compile.  This engine is the standard
 continuous-batching formulation (Orca/vLLM):
 
 * a fixed ring of ``num_slots`` **batch slots**;
-* ONE jitted single-token **decode step** over all slots, compiled once at
-  construction — every per-request quantity (position, last token, RNG key,
-  temperature/top-k/top-p, active flag) is *data*, so admitting or retiring
-  a request never retraces (dklint DK102);
+* ONE jitted single-token **decode step** over all slots — every
+  per-request quantity (position, last token, RNG key,
+  temperature/top-k/top-p, active flag, speculative opt-in) is *data*, so
+  admitting or retiring a request never retraces (dklint DK102);
 * a **paged KV cache** (:mod:`distkeras_tpu.serving.cache`): K/V pools
   shared by all slots, per-slot page tables, pages allocated at admission
   and freed at retirement;
@@ -23,20 +23,45 @@ continuous-batching formulation (Orca/vLLM):
   histograms, queue depth, token/request counters — visible on the
   flightdeck ``/metrics`` scrape.
 
+Fast paths (each optional, all compile-count pinned):
+
+* **Prefill width bucketing** — prompts prefill at the smallest
+  power-of-two page-multiple width that fits them (``prefill_buckets``)
+  instead of the slot's full page capacity, so a 12-token prompt stops
+  paying max-context FLOPs.  One program per *used* bucket, compiled
+  lazily; ``serving_prefill_padded_tokens`` counts the padding burned so
+  the win is visible on ``/metrics``.
+* **Speculative decoding** (``draft_model``) — a cheaper draft model
+  (anything with a ``decode_spec``, e.g. a shallower ``TransformerLM``)
+  proposes ``spec_tokens`` tokens per engine iteration via single-token
+  draft steps; ONE multi-token target step verifies the window against the
+  paged cache and emits the accepted prefix plus a correction token
+  (Leviathan et al., arXiv:2211.17192 — see
+  :func:`distkeras_tpu.serving.sampling.speculative_verify`).  There is no
+  bonus token, so draft and target caches never develop holes.  Greedy
+  emitted tokens are always target-argmax rows, hence bitwise identical to
+  the non-speculative greedy stream regardless of draft quality; stochastic
+  requests use exact acceptance-rejection resampling.  Requests opt out per
+  call (``speculative=False``) and ride the same program as traced data.
+* **Sharded decode** (``mesh``) — the target's prefill/decode/verify
+  programs run under a tensor-parallel ``shard_map`` (heads sharded, MLP
+  and embeddings replicated), so one engine serves from every local device.
+
 Numerics: the engine re-runs the model's own flax submodules
 (``nn.LayerNorm`` / ``nn.DenseGeneral`` / ``nn.Dense`` / the
 ``_decode_attention`` masking math) over param subtrees sliced out by the
 model's ``decode_spec`` hook, so greedy requests emit tokens **bitwise
 identical** to ``greedy_generate`` (tests/test_serving.py pins this under
-staggered concurrent arrival).  Prefill pads the prompt to the slot's full
-page capacity — positions past the prompt are causally masked and their
-cache rows are overwritten by decode before ever becoming visible, so
-padding changes nothing but FLOPs.  (A production build would bucket
-prefill widths; one width keeps this engine at exactly two programs.)
+staggered concurrent arrival).  Prefill pads the prompt to its bucket
+width — positions past the prompt are causally masked and their cache rows
+are overwritten by decode before ever becoming visible, so padding changes
+nothing but FLOPs.
 
 RNG: each request carries its own ``PRNGKey(seed)`` chain, split once per
-token *of that request* — sampled output is a function of (params, prompt,
-knobs, seed) alone, independent of whatever else shares the batch.
+engine iteration *of that request* — sampled output is a function of
+(params, prompt, knobs, seed) alone, independent of whatever else shares
+the batch.  Speculative opt-out slots consume the exact non-speculative
+key chain, so a request's tokens don't change when its neighbours opt in.
 """
 
 from __future__ import annotations
@@ -44,7 +69,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -52,13 +77,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu.sanitizer import lockwatch
-from distkeras_tpu.serving.cache import PagedKVCache
+from distkeras_tpu.serving.cache import PagedKVCache, append_rows, rollback_rows
 from distkeras_tpu.serving.frontend import (
     GenerateRequest,
     GenerateResult,
     RequestQueue,
 )
-from distkeras_tpu.serving.sampling import sample_one, sample_tokens
+from distkeras_tpu.serving.sampling import (
+    modified_probs,
+    sample_one,
+    sample_tokens,
+    speculative_verify_tokens,
+)
 
 __all__ = ["ServingEngine", "serving_metrics"]
 
@@ -78,6 +108,10 @@ def serving_metrics(registry=None) -> dict:
             "serving_token_latency_seconds",
             help="wall time of one continuous-batching decode step",
         ),
+        "prefill_seconds": registry.histogram(
+            "serving_prefill_seconds",
+            help="wall time of one prefill dispatch (bucketed width)",
+        ),
         "queue_depth": registry.gauge(
             "serving_queue_depth", help="requests waiting for a batch slot"
         ),
@@ -96,6 +130,23 @@ def serving_metrics(registry=None) -> dict:
         "rejected": registry.counter(
             "serving_requests_rejected_total",
             help="requests shed by queue backpressure",
+        ),
+        "prefill_padded": registry.counter(
+            "serving_prefill_padded_tokens",
+            help="padding tokens burned by bucketed prefill (width - prompt)",
+        ),
+        "decode_steps": registry.counter(
+            "serving_decode_steps_total",
+            help="target decode/verify iterations (speculative emits >1 "
+                 "token per step, so steps/tokens < 1)",
+        ),
+        "spec_proposed": registry.counter(
+            "serving_spec_proposed_total",
+            help="draft tokens proposed by speculative decoding",
+        ),
+        "spec_accepted": registry.counter(
+            "serving_spec_accepted_total",
+            help="draft tokens accepted by target verification",
         ),
     }
 
@@ -153,6 +204,8 @@ def _resolve_spec(model, params) -> _Spec:
     raw = hook(params)
     cfg = raw["config"]
     qkv = raw["blocks"][0]["_SelfAttention_0"]["qkv"]["kernel"]
+    # prefer the config's head geometry (authoritative even if the kernels
+    # are resharded later); fall back to kernel shapes for older hooks
     return _Spec(
         tok=jnp.asarray(raw["embed"]["tok"]),
         pos=jnp.asarray(raw["embed"]["pos"]),
@@ -160,18 +213,21 @@ def _resolve_spec(model, params) -> _Spec:
         final_ln=raw["final_ln"],
         head=raw["head"],
         dim=int(cfg["dim"]),
-        heads=int(qkv.shape[-2]),
-        head_dim=int(qkv.shape[-1]),
+        heads=int(cfg.get("heads", qkv.shape[-2])),
+        head_dim=int(cfg.get("head_dim", qkv.shape[-1])),
         max_len=int(cfg["max_len"]),
         vocab=int(cfg["vocab_size"]),
         ln_eps=float(cfg["ln_eps"]),
     )
 
 
-def _block_apply(bp, x, attend, eps):
+def _block_apply(bp, x, attend, eps, psum=None):
     """One encoder block over param subtree ``bp``, reusing the model's own
     flax submodules so the math is bit-identical to training/`generate`.
-    ``attend(q, k, v)`` supplies the paged-cache attention."""
+    ``attend(q, k, v)`` supplies the paged-cache attention.  Head counts are
+    read off the (possibly shard-local) kernel shapes, so the same function
+    serves both the replicated and the tensor-parallel build; ``psum`` is
+    the cross-shard reduction under ``shard_map`` (None when unsharded)."""
     ap = bp["_SelfAttention_0"]
     dim = bp["Dense_1"]["kernel"].shape[-1]
     mlp = bp["Dense_0"]["kernel"].shape[-1]
@@ -180,7 +236,14 @@ def _block_apply(bp, x, attend, eps):
     qkv = nn.DenseGeneral((3, heads, head_dim)).apply({"params": ap["qkv"]}, h)
     q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
     out = attend(q, k, v)
-    h = nn.DenseGeneral(dim, axis=(-2, -1)).apply({"params": ap["proj"]}, out)
+    if psum is None:
+        h = nn.DenseGeneral(dim, axis=(-2, -1)).apply({"params": ap["proj"]}, out)
+    else:
+        # tensor-parallel: each shard contracts its local heads bias-free,
+        # the psum sums the partials, and the replicated bias is added once
+        # (DenseGeneral per shard would add it axis-size times)
+        h = jnp.einsum("...hd,hdo->...o", out, ap["proj"]["kernel"])
+        h = psum(h) + ap["proj"]["bias"]
     x = x + h
     h = nn.LayerNorm(epsilon=eps).apply({"params": bp["LayerNorm_1"]}, x)
     h = nn.Dense(mlp).apply({"params": bp["Dense_0"]}, h)
@@ -192,6 +255,30 @@ def _block_apply(bp, x, attend, eps):
 def _head_apply(final_ln, head, x, eps):
     h = nn.LayerNorm(epsilon=eps).apply({"params": final_ln}, x)
     return nn.Dense(head["kernel"].shape[-1]).apply({"params": head}, h)
+
+
+def _resolve_buckets(prefill_buckets, page_size: int, max_context: int):
+    """The prefill width ladder: ascending page-multiple widths ending at
+    ``max_context``.  Default: ``page_size * 2**i`` capped at capacity."""
+    if prefill_buckets is None:
+        widths, w = [], page_size
+        while w < max_context:
+            widths.append(w)
+            w *= 2
+        widths.append(max_context)
+        return tuple(widths)
+    widths = sorted({int(w) for w in prefill_buckets})
+    if not widths:
+        raise ValueError("prefill_buckets must be non-empty")
+    for w in widths:
+        if w < 1 or w > max_context or w % page_size:
+            raise ValueError(
+                f"prefill bucket {w} must be a positive multiple of "
+                f"page_size {page_size} and <= max context {max_context}"
+            )
+    if widths[-1] != max_context:
+        widths.append(max_context)  # every admissible prompt needs a bucket
+    return tuple(widths)
 
 
 # -------------------------------------------------------------- bookkeeping
@@ -253,12 +340,20 @@ class ServingEngine:
     ``submit``/``generate`` (or explicitly via :meth:`start`).  ``model``
     is a ``TrainedModel``, or a ``TransformerLM``/``StagedLM`` (raw or
     behind ``FlaxModel``) plus ``params``.
+
+    Fast-path knobs: ``prefill_buckets`` (width ladder; default
+    power-of-two), ``draft_model``/``draft_params``/``spec_tokens``
+    (speculative decoding), ``mesh`` (a 1-D tensor-parallel
+    ``jax.sharding.Mesh``; ``heads`` must divide by its size).
     """
 
     def __init__(self, model, params=None, *, num_slots: int = 4,
                  page_size: int = 16, pages_per_slot: Optional[int] = None,
                  num_pages: Optional[int] = None, queue_size: int = 64,
-                 registry=None, dtype=jnp.float32):
+                 registry=None, dtype=jnp.float32,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 draft_model=None, draft_params=None, spec_tokens: int = 4,
+                 mesh=None):
         self._spec = _resolve_spec(model, params)
         spec = self._spec
         if pages_per_slot is None:
@@ -270,51 +365,174 @@ class ServingEngine:
             heads=spec.heads, head_dim=spec.head_dim,
             num_pages=num_pages, dtype=dtype,
         )
-        # one prefill width = the slot's whole page capacity (see module doc)
         self._width = self._cache.max_context()
+        self._buckets = _resolve_buckets(
+            prefill_buckets, self._cache.page_size, self._width)
         self._queue = RequestQueue(queue_size)
         self._metrics = serving_metrics(registry)
+
+        # ------------------------------------------------ tensor parallelism
+        self._mesh = mesh
+        self._psum = None
+        if mesh is not None:
+            if len(mesh.axis_names) != 1:
+                raise ValueError(
+                    "serving mesh must be 1-D (one tensor-parallel axis); "
+                    f"got axes {mesh.axis_names}"
+                )
+            self._tp_axis = mesh.axis_names[0]
+            tp = int(mesh.devices.size)
+            if spec.heads % tp:
+                raise ValueError(
+                    f"model heads {spec.heads} not divisible by mesh size {tp}"
+                )
+            axis = self._tp_axis
+            self._psum = lambda x: jax.lax.psum(x, axis)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            pool_sharding = NamedSharding(mesh, P(None, None, None, axis, None))
+            self._cache.k_pages = jax.device_put(self._cache.k_pages, pool_sharding)
+            self._cache.v_pages = jax.device_put(self._cache.v_pages, pool_sharding)
+
+        # --------------------------------------------------- draft / verify
+        self._draft_spec = None
+        self._draft_cache = None
+        self._spec_tokens = int(spec_tokens)
+        if draft_model is not None:
+            if self._spec_tokens < 1:
+                raise ValueError("spec_tokens must be >= 1")
+            dspec = _resolve_spec(draft_model, draft_params)
+            if dspec.vocab != spec.vocab:
+                raise ValueError(
+                    f"draft vocab {dspec.vocab} != target vocab {spec.vocab}"
+                )
+            serviceable = min(self._width, spec.max_len)
+            if dspec.max_len < serviceable:
+                raise ValueError(
+                    f"draft max_len {dspec.max_len} < serviceable context "
+                    f"{serviceable}; pick a draft trained at the same length"
+                )
+            self._draft_spec = dspec
+            # same page geometry so the target's page tables address the
+            # draft pools directly; bookkeeping (free list) is never used —
+            # the draft is replicated even under a mesh (it's cheap by
+            # construction, and sharding it would serialize two shard_maps)
+            self._draft_cache = PagedKVCache(
+                num_layers=len(dspec.blocks), num_slots=num_slots,
+                page_size=page_size, pages_per_slot=pages_per_slot,
+                heads=dspec.heads, head_dim=dspec.head_dim,
+                num_pages=self._cache.num_pages, dtype=dtype,
+            )
 
         s = self.num_slots
         self._slots: List[Optional[_SlotState]] = [None] * s
         self._pos = np.zeros(s, np.int32)        # position of the fed token
         self._last = np.zeros(s, np.int32)       # token being fed this step
         self._keys = np.zeros((s, 2), np.uint32)
+        self._draft_keys = np.zeros((s, 2), np.uint32)
         self._temp = np.zeros(s, np.float32)
         self._topk = np.zeros(s, np.int32)
         self._topp = np.ones(s, np.float32)
         self._active = np.zeros(s, bool)
+        self._spec_on = np.zeros(s, bool)
 
         self._cv = lockwatch.maybe_wrap(threading.Condition(), "serving.engine")
         self._running = False
         self._thread: Optional[threading.Thread] = None
 
-        # Both programs compile exactly once, here — never per request
-        # (the retrace pin in tests/test_serving.py counts on it).
-        self._prefill = jax.jit(self._build_prefill(), donate_argnums=(1, 2))
-        self._decode = jax.jit(self._build_decode(), donate_argnums=(1, 2))
+        # Programs compile once per (engine, mesh) config — never per
+        # request (the retrace pin in tests/test_serving.py counts on it):
+        # one decode OR (one draft step + one verify), plus one prefill per
+        # *used* bucket width, built lazily in _prefill_for.
+        self._prefill_fns: Dict[Tuple[str, int], Any] = {}
+        if self._draft_spec is None:
+            self._decode = jax.jit(
+                self._maybe_shard(self._build_decode(), n_rest=8, n_out=2),
+                donate_argnums=(1, 2))
+        else:
+            self._draft_step = jax.jit(
+                self._build_draft_step(), donate_argnums=(1, 2))
+            self._verify = jax.jit(
+                self._maybe_shard(self._build_verify(), n_rest=11, n_out=4),
+                donate_argnums=(1, 2))
 
     # ------------------------------------------------------- traced programs
 
-    def _build_prefill(self):
-        spec, cache = self._spec, self._cache
-        ps, pps, width = cache.page_size, cache.pages_per_slot, self._width
-        heads, head_dim, eps = spec.heads, spec.head_dim, spec.ln_eps
+    def _target_param_specs(self):
+        """PartitionSpecs for the target params under the tensor-parallel
+        mesh: qkv sharded over heads, attention proj contracting over the
+        sharded heads, everything else (embeddings, LN, MLP, head)
+        replicated."""
+        from jax.sharding import PartitionSpec as P
 
-        def prefill(params, kpool, vpool, tokens, table, length, key,
-                    temp, top_k, top_p):
-            # tokens [1, width] right-padded; table [pps]; length traced.
+        axis = self._tp_axis
+        specs = jax.tree.map(lambda _: P(), self._spec.params())
+        for bs in specs["blocks"]:
+            ap = bs["_SelfAttention_0"]
+            ap["qkv"]["kernel"] = P(None, None, axis, None)
+            ap["qkv"]["bias"] = P(None, axis, None)
+            ap["proj"]["kernel"] = P(axis, None, None)
+        return specs
+
+    def _maybe_shard(self, fn, n_rest: int, n_out: int):
+        """Wrap a ``(params, kpool, vpool, *rest) -> (kpool, vpool, *outs)``
+        step in a tensor-parallel shard_map when the engine has a mesh.
+        Pools are heads-sharded; every other input/output is replicated."""
+        if self._mesh is None:
+            return fn
+        from jax.sharding import PartitionSpec as P
+
+        from distkeras_tpu.utils import compat
+
+        pool = P(None, None, None, self._tp_axis, None)
+        in_specs = (self._target_param_specs(), pool, pool) + (P(),) * n_rest
+        out_specs = (pool, pool) + (P(),) * n_out
+        # check_vma=False: replication of the sampled outputs holds by
+        # construction (inputs replicated, every cross-head contraction is
+        # psummed) but jax 0.4's check_rep can't always prove it
+        return compat.shard_map(
+            fn, self._mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False)
+
+    def _prefill_for(self, width: int, role: str = "target"):
+        """The jitted prefill program for one bucket width, compiled on
+        first use.  ``role`` is "target" (samples the first token) or
+        "draft" (cache writes only)."""
+        key = (role, width)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            if role == "target":
+                fn = jax.jit(
+                    self._maybe_shard(
+                        self._build_prefill(width, self._spec, sample=True,
+                                            psum=self._psum),
+                        n_rest=7, n_out=2),
+                    donate_argnums=(1, 2))
+            else:
+                fn = jax.jit(
+                    self._build_prefill(width, self._draft_spec, sample=False,
+                                        psum=None),
+                    donate_argnums=(1, 2))
+            self._prefill_fns[key] = fn
+        return fn
+
+    def _build_prefill(self, width: int, spec: _Spec, *, sample: bool, psum):
+        ps = self._cache.page_size
+        npages = width // ps
+        eps = spec.ln_eps
+
+        def trunk(params, pools, tokens, table):
+            # tokens [1, width] right-padded; table [npages].
             positions = jnp.clip(jnp.arange(width), 0, spec.max_len - 1)
             x = params["tok"][tokens] + params["pos"][positions][None]
-            pools = {"k": kpool, "v": vpool}
 
             def paged_attend(li):
                 def attend(q, k, v):
                     # stash the whole padded chunk into this slot's pages;
-                    # rows past `length` land on scratch/overwritten pages
+                    # rows past the prompt land on scratch/overwritten pages
                     # and are causally masked below — never attended.
-                    kc = k[0].reshape(pps, ps, heads, head_dim)
-                    vc = v[0].reshape(pps, ps, heads, head_dim)
+                    kc = k[0].reshape(npages, ps, *k.shape[-2:])
+                    vc = v[0].reshape(npages, ps, *v.shape[-2:])
                     pools["k"] = pools["k"].at[li, table].set(kc)
                     pools["v"] = pools["v"].at[li, table].set(vc)
                     # causal attention over the chunk itself (same masking
@@ -335,7 +553,24 @@ class ServingEngine:
                 return attend
 
             for li, bp in enumerate(params["blocks"]):
-                x = _block_apply(bp, x, paged_attend(li), eps)
+                x = _block_apply(bp, x, paged_attend(li), eps, psum=psum)
+            return x
+
+        if not sample:
+            def prefill_cache_only(params, kpool, vpool, tokens, table):
+                # draft prefill: only the K/V writes matter — XLA dead-code
+                # eliminates the attention outputs, leaving the cheap qkv
+                # projections per layer
+                pools = {"k": kpool, "v": vpool}
+                trunk(params, pools, tokens, table)
+                return pools["k"], pools["v"]
+
+            return prefill_cache_only
+
+        def prefill(params, kpool, vpool, tokens, table, length, key,
+                    temp, top_k, top_p):
+            pools = {"k": kpool, "v": vpool}
+            x = trunk(params, pools, tokens, table)
             logits = _head_apply(params["final_ln"], params["head"], x, eps)
             row = jax.lax.dynamic_index_in_dim(
                 logits[0], length - 1, axis=0, keepdims=False
@@ -348,9 +583,9 @@ class ServingEngine:
 
     def _build_decode(self):
         spec, cache = self._spec, self._cache
-        ps, pps = cache.page_size, cache.pages_per_slot
         s, ctx = self.num_slots, self._width
-        heads, head_dim, eps = spec.heads, spec.head_dim, spec.ln_eps
+        eps = spec.ln_eps
+        psum = self._psum
 
         def decode(params, kpool, vpool, tables, pos, last, keys,
                    temp, top_k, top_p, active):
@@ -362,16 +597,61 @@ class ServingEngine:
             ]
             x = x[:, None, :]  # [slots, 1, dim]
             pools = {"k": kpool, "v": vpool}
-            slot_ix = jnp.arange(s)
-            phys = tables[slot_ix, jnp.clip(pos // ps, 0, pps - 1)]
-            off = pos % ps
 
             def paged_attend(li):
                 def attend(q, k, v):
-                    pools["k"] = pools["k"].at[li, phys, off].set(k[:, 0])
-                    pools["v"] = pools["v"].at[li, phys, off].set(v[:, 0])
-                    kg = pools["k"][li][tables].reshape(s, ctx, heads, head_dim)
-                    vg = pools["v"][li][tables].reshape(s, ctx, heads, head_dim)
+                    pools["k"] = append_rows(pools["k"], li, tables, pos, k)
+                    pools["v"] = append_rows(pools["v"], li, tables, pos, v)
+                    kg = pools["k"][li][tables]
+                    kg = kg.reshape(s, ctx, *kg.shape[-2:])
+                    vg = pools["v"][li][tables]
+                    vg = vg.reshape(s, ctx, *vg.shape[-2:])
+                    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+                    sc = jnp.einsum("shd,skhd->shk", q[:, 0], kg) * scale
+                    mask = jnp.arange(ctx)[None, :] <= pos[:, None]
+                    sc = jnp.where(mask[:, None, :], sc, -jnp.inf)
+                    out = jnp.einsum(
+                        "shk,skhd->shd", jax.nn.softmax(sc, axis=-1), vg
+                    )
+                    return out[:, None]
+
+                return attend
+
+            for li, bp in enumerate(params["blocks"]):
+                x = _block_apply(bp, x, paged_attend(li), eps, psum=psum)
+            logits = _head_apply(params["final_ln"], params["head"], x, eps)[:, 0]
+            split = jax.vmap(jax.random.split)(keys)
+            new_keys, subs = split[:, 0], split[:, 1]
+            tok = sample_tokens(logits, subs, temp, top_k, top_p)
+            tok = jnp.where(active, tok, 0)
+            return pools["k"], pools["v"], tok, new_keys
+
+        return decode
+
+    def _build_draft_step(self):
+        """One single-token draft step over all slots: writes draft K/V at
+        ``pos``, samples the proposal, and returns the draft's *modified*
+        distribution (the q of the acceptance test).  Always replicated."""
+        dspec, cache = self._draft_spec, self._cache
+        s, ctx = self.num_slots, self._width
+        eps = dspec.ln_eps
+
+        def draft_step(params, kpool, vpool, tables, pos, last, keys,
+                       temp, top_k, top_p, active):
+            x = params["tok"][last] + params["pos"][
+                jnp.clip(pos, 0, dspec.max_len - 1)
+            ]
+            x = x[:, None, :]
+            pools = {"k": kpool, "v": vpool}
+
+            def paged_attend(li):
+                def attend(q, k, v):
+                    pools["k"] = append_rows(pools["k"], li, tables, pos, k)
+                    pools["v"] = append_rows(pools["v"], li, tables, pos, v)
+                    kg = pools["k"][li][tables]
+                    kg = kg.reshape(s, ctx, *kg.shape[-2:])
+                    vg = pools["v"][li][tables]
+                    vg = vg.reshape(s, ctx, *vg.shape[-2:])
                     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
                     sc = jnp.einsum("shd,skhd->shk", q[:, 0], kg) * scale
                     mask = jnp.arange(ctx)[None, :] <= pos[:, None]
@@ -390,9 +670,70 @@ class ServingEngine:
             new_keys, subs = split[:, 0], split[:, 1]
             tok = sample_tokens(logits, subs, temp, top_k, top_p)
             tok = jnp.where(active, tok, 0)
-            return pools["k"], pools["v"], tok, new_keys
+            qprobs = jax.vmap(modified_probs)(logits, temp, top_k, top_p)
+            return pools["k"], pools["v"], tok, qprobs, new_keys
 
-        return decode
+        return draft_step
+
+    def _build_verify(self):
+        """The multi-token target step: feed the window ``[last, d_1 ..
+        d_{m-1}]``, write its K/V through the page tables, compute all m
+        next-token logits in one pass, judge the drafts per slot
+        (:func:`speculative_verify_tokens`), and roll the rejected suffix
+        rows back out of the pools."""
+        spec = self._spec
+        s, ctx, m = self.num_slots, self._width, self._spec_tokens
+        eps = spec.ln_eps
+        psum = self._psum
+
+        def verify(params, kpool, vpool, tables, pos, last, drafts, qprobs,
+                   keys, temp, top_k, top_p, active, spec_on):
+            # drafts: tuple of m [slots] proposals (d_1..d_m); qprobs: tuple
+            # of m [slots, vocab] draft distributions.  Stacked here, inside
+            # the program, so the host loop ships the draft step's outputs
+            # without an extra dispatch.
+            d = jnp.stack(drafts, axis=1)        # [slots, m]
+            q_d = jnp.stack(qprobs, axis=1)      # [slots, m, vocab]
+            fed = jnp.concatenate([last[:, None], d[:, :-1]], axis=1)
+            positions = pos[:, None] + jnp.arange(m)[None, :]  # [slots, m]
+            x = params["tok"][fed] + params["pos"][
+                jnp.clip(positions, 0, spec.max_len - 1)
+            ]
+            pools = {"k": kpool, "v": vpool}
+
+            def paged_attend(li):
+                def attend(q, k, v):
+                    pools["k"] = append_rows(pools["k"], li, tables, pos, k)
+                    pools["v"] = append_rows(pools["v"], li, tables, pos, v)
+                    kg = pools["k"][li][tables]
+                    kg = kg.reshape(s, ctx, *kg.shape[-2:])
+                    vg = pools["v"][li][tables]
+                    vg = vg.reshape(s, ctx, *vg.shape[-2:])
+                    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+                    sc = jnp.einsum("smhd,skhd->smhk", q, kg) * scale
+                    mask = jnp.arange(ctx)[None, None, :] <= positions[:, :, None]
+                    sc = jnp.where(mask[:, :, None, :], sc, -jnp.inf)
+                    out = jnp.einsum(
+                        "smhk,skhd->smhd", jax.nn.softmax(sc, axis=-1), vg
+                    )
+                    return out
+
+                return attend
+
+            for li, bp in enumerate(params["blocks"]):
+                x = _block_apply(bp, x, paged_attend(li), eps, psum=psum)
+            logits = _head_apply(params["final_ln"], params["head"], x, eps)
+            out, count, accepted, new_keys = speculative_verify_tokens(
+                logits, d, q_d, keys, temp, top_k, top_p, spec_on & active)
+            out = jnp.where(active[:, None], out, 0)
+            # erase the rejected suffix so the pools only ever hold
+            # accepted-token K/V between iterations
+            for li in range(len(params["blocks"])):
+                pools["k"] = rollback_rows(pools["k"], li, tables, pos, count, m)
+                pools["v"] = rollback_rows(pools["v"], li, tables, pos, count, m)
+            return pools["k"], pools["v"], out, count, accepted, new_keys
+
+        return verify
 
     # ----------------------------------------------------------- public API
 
@@ -443,6 +784,11 @@ class ServingEngine:
             )
         if int(np.max(request.prompt)) >= self._spec.vocab:
             raise ValueError("prompt token id out of vocabulary")
+        if request.speculative and self._draft_spec is None:
+            raise ValueError(
+                "request asks for speculative decoding but the engine was "
+                "built without a draft_model"
+            )
         max_new = min(request.max_new_tokens, self._spec.max_len - plen,
                       self._width - plen)
         pending = _Pending(request, max_new, time.perf_counter())
@@ -461,7 +807,7 @@ class ServingEngine:
                  timeout: Optional[float] = 60.0,
                  **knobs) -> GenerateResult:
         """Blocking convenience: submit one request, wait for its result.
-        ``knobs`` forwards temperature/top_k/top_p/seed/eos_id."""
+        ``knobs`` forwards temperature/top_k/top_p/seed/eos_id/speculative."""
         req = GenerateRequest(prompt=[int(t) for t in prompt],
                               max_new_tokens=max_new_tokens, **knobs)
         result = self.submit(req).result(timeout=timeout)
@@ -477,6 +823,10 @@ class ServingEngine:
             "pages_in_use": float(self._cache.pages_in_use),
             "pages_free": float(self._cache.pages_free),
         }
+
+    @property
+    def prefill_buckets(self) -> Tuple[int, ...]:
+        return self._buckets
 
     # ------------------------------------------------------------ host loop
 
@@ -520,18 +870,36 @@ class ServingEngine:
         req = pending.request
         plen = len(req.prompt)
         self._cache.alloc(slot, need)
-        tokens = np.zeros((1, self._width), np.int32)
+        # smallest bucket that fits the prompt (the ladder always ends at
+        # max_context and submit bounded plen, so next() can't exhaust)
+        width = next(w for w in self._buckets if w >= plen)
+        t0 = time.perf_counter()
+        tokens = np.zeros((1, width), np.int32)
         tokens[0, :plen] = req.prompt
-        kp, vp, tok, key = self._prefill(
+        tokens_dev = jnp.asarray(tokens)
+        table = jnp.asarray(
+            self._cache.tables[slot, : width // self._cache.page_size])
+        kp, vp, tok, key = self._prefill_for(width)(
             self._spec.params(), self._cache.k_pages, self._cache.v_pages,
-            jnp.asarray(tokens), jnp.asarray(self._cache.tables[slot]),
-            jnp.int32(plen), jax.random.PRNGKey(req.seed),
+            tokens_dev, table, jnp.int32(plen), jax.random.PRNGKey(req.seed),
             jnp.float32(req.temperature), jnp.int32(req.top_k),
             jnp.float32(req.top_p),
         )
         self._cache.k_pages, self._cache.v_pages = kp, vp
+        spec_on = self._draft_spec is not None and req.speculative is not False
+        if spec_on:
+            dc = self._draft_cache
+            dkp, dvp = self._prefill_for(width, role="draft")(
+                self._draft_spec.params(), dc.k_pages, dc.v_pages,
+                tokens_dev, table)
+            dc.k_pages, dc.v_pages = dkp, dvp
+            # a draft chain decorrelated from the request's target chain
+            self._draft_keys[slot] = np.asarray(
+                jax.random.fold_in(jax.random.PRNGKey(req.seed), 7))
         tok0 = int(np.asarray(tok))
         now = time.perf_counter()
+        self._metrics["prefill_seconds"].observe(now - t0)
+        self._metrics["prefill_padded"].inc(width - plen)
 
         state = _SlotState(pending, plen)
         state.tokens.append(tok0)
@@ -546,6 +914,7 @@ class ServingEngine:
         self._topk[slot] = req.top_k
         self._topp[slot] = req.top_p
         self._active[slot] = True
+        self._spec_on[slot] = spec_on
         self._refresh_gauges()
 
         if req.eos_id is not None and tok0 == req.eos_id:
@@ -554,9 +923,17 @@ class ServingEngine:
             self._retire(slot, "length")
 
     def _decode_once(self) -> bool:
-        """One continuous-batching decode step over every active slot."""
+        """One engine iteration over every active slot: a plain decode
+        step, or (with a draft model) m draft steps + one verify step."""
         if not self._active.any():
             return False
+        if self._draft_spec is not None:
+            self._spec_once()
+        else:
+            self._plain_once()
+        return True
+
+    def _plain_once(self) -> None:
         t0 = time.perf_counter()
         kp, vp, tok, keys = self._decode(
             self._spec.params(), self._cache.k_pages, self._cache.v_pages,
@@ -569,6 +946,7 @@ class ServingEngine:
         toks = np.asarray(tok)          # device sync: the step is done here
         self._keys = np.array(keys)     # np.array: keep the host copy writable
         self._metrics["token_latency"].observe(time.perf_counter() - t0)
+        self._metrics["decode_steps"].inc()
 
         for slot in range(self.num_slots):
             state = self._slots[slot]
@@ -584,13 +962,81 @@ class ServingEngine:
                 self._retire(slot, "eos")
             elif len(state.tokens) >= state.pending.max_new:
                 self._retire(slot, "length")
-        return True
+
+    def _spec_once(self) -> None:
+        """One speculative iteration: chain m draft steps (device arrays
+        flow straight between dispatches — no host syncs), verify the
+        window in one target step, then emit each slot's accepted prefix."""
+        t0 = time.perf_counter()
+        m = self._spec_tokens
+        tables = jnp.asarray(self._cache.tables)
+        temp = jnp.asarray(self._temp)
+        topk = jnp.asarray(self._topk)
+        topp = jnp.asarray(self._topp)
+        active = jnp.asarray(self._active)
+        base_pos = self._pos
+        last = jnp.asarray(self._last)
+        dkeys = jnp.asarray(self._draft_keys)
+        dc = self._draft_cache
+        dparams = self._draft_spec.params()
+        drafts, qprobs = [], []
+        for i in range(m):
+            dc.k_pages, dc.v_pages, tok, qp, dkeys = self._draft_step(
+                dparams, dc.k_pages, dc.v_pages, tables,
+                jnp.asarray(base_pos + i), last, dkeys, temp, topk, topp,
+                active)
+            drafts.append(tok)
+            qprobs.append(qp)
+            last = tok
+        kp, vp, out, count, accepted, keys = self._verify(
+            self._spec.params(), self._cache.k_pages, self._cache.v_pages,
+            tables, jnp.asarray(base_pos), jnp.asarray(self._last),
+            tuple(drafts), tuple(qprobs), jnp.asarray(self._keys),
+            temp, topk, topp, active, jnp.asarray(self._spec_on))
+        self._cache.k_pages, self._cache.v_pages = kp, vp
+        out = np.asarray(out)           # device sync: the iteration is done
+        counts = np.asarray(count)
+        acc = np.asarray(accepted)
+        self._keys = np.array(keys)
+        self._draft_keys = np.array(dkeys)
+        self._metrics["token_latency"].observe(time.perf_counter() - t0)
+        self._metrics["decode_steps"].inc()
+        spec_slots = self._active & self._spec_on
+        n_spec = int(spec_slots.sum())
+        if n_spec:
+            self._metrics["spec_proposed"].inc(m * n_spec)
+            self._metrics["spec_accepted"].inc(int(acc[spec_slots].sum()))
+
+        for slot in range(self.num_slots):
+            state = self._slots[slot]
+            if state is None or not self._active[slot]:
+                continue
+            req = state.pending.request
+            retired = False
+            emitted = 0
+            for j in range(int(counts[slot])):
+                t = int(out[slot, j])
+                state.tokens.append(t)
+                emitted += 1
+                self._metrics["tokens"].inc()
+                if req.eos_id is not None and t == req.eos_id:
+                    self._retire(slot, "eos")
+                    retired = True
+                    break
+                if len(state.tokens) >= state.pending.max_new:
+                    self._retire(slot, "length")
+                    retired = True
+                    break
+            if not retired:
+                self._pos[slot] += emitted
+                self._last[slot] = int(out[slot, emitted - 1])
 
     def _retire(self, slot: int, reason: str) -> None:
         state = self._slots[slot]
         self._cache.free(slot)
         self._slots[slot] = None
         self._active[slot] = False
+        self._spec_on[slot] = False
         self._pos[slot] = 0
         self._last[slot] = 0
         self._temp[slot] = 0.0

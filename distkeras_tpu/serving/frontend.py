@@ -28,6 +28,7 @@ __all__ = [
     "QueueFull",
     "RequestQueue",
     "install_http_endpoint",
+    "serve_flags",
 ]
 
 
@@ -43,7 +44,9 @@ class GenerateRequest:
     ``temperature <= 0`` (default) means greedy decode; ``seed`` fixes the
     sampling RNG chain so a request's tokens are deterministic regardless
     of what else shares the batch; ``eos_id`` retires the request early
-    when that token is emitted.
+    when that token is emitted.  ``speculative`` opts a single request in
+    (True) or out (False) of the engine's draft-model fast path; None
+    (default) follows the engine — speculative whenever it has a draft.
     """
 
     prompt: List[int]
@@ -54,6 +57,7 @@ class GenerateRequest:
     seed: int = 0
     eos_id: Optional[int] = None
     request_id: str = ""
+    speculative: Optional[bool] = None
 
     def validate(self) -> None:
         if not self.prompt:
@@ -128,6 +132,32 @@ class RequestQueue:
 # ---------------------------------------------------------------- HTTP
 
 
+def _parse_tristate(value) -> Optional[bool]:
+    """``speculative`` over the wire: absent/empty -> None (engine default),
+    otherwise the usual JSON/query truthy spellings."""
+    if value in (None, "", "None", "null"):
+        return None
+    if isinstance(value, bool):
+        return value
+    return str(value).strip().lower() in ("1", "true", "yes", "on")
+
+
+def serve_flags() -> dict:
+    """Engine construction knobs passed down by the job daemon's ``serve``
+    verb (``Job.serve(flags=...)``) as the ``DISTKERAS_SERVE_FLAGS`` JSON
+    env var — e.g. ``{"spec_tokens": 4, "num_slots": 8}``.  Serve scripts
+    splat this into the engine: ``ServingEngine(model, params,
+    **serve_flags())``.  Returns ``{}`` when unset or unparseable (a broken
+    deploy flag should degrade to defaults, not kill the serving job)."""
+    import os
+
+    try:
+        flags = json.loads(os.environ.get("DISTKERAS_SERVE_FLAGS") or "{}")
+    except ValueError:
+        return {}
+    return flags if isinstance(flags, dict) else {}
+
+
 def _parse_request(request: dict) -> GenerateRequest:
     """Build a :class:`GenerateRequest` from the flightdeck request dict
     (``method``/``query``/``body``).  GET: ``prompt=1,2,3&max_new_tokens=8``;
@@ -151,6 +181,7 @@ def _parse_request(request: dict) -> GenerateRequest:
         eos_id=(None if payload.get("eos_id") in (None, "", "None")
                 else int(payload["eos_id"])),
         request_id=str(payload.get("request_id", "")),
+        speculative=_parse_tristate(payload.get("speculative")),
     )
     req.validate()
     return req

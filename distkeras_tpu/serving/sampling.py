@@ -14,6 +14,12 @@ Conventions (matching the common HF/vLLM semantics):
 * ``top_p >= 1`` — no nucleus truncation; the smallest prefix of
   probability-sorted tokens with cumulative mass ``>= top_p`` is kept
   (the token that crosses the threshold is always kept).
+
+Speculative decoding (:func:`speculative_verify`) builds on the same
+filtered distributions: the acceptance test and the rejection-resample both
+use the **modified** distribution (after temperature/top-k/top-p), which is
+what makes draft-then-verify sampling exact for the filtered target
+distribution (Leviathan et al., arXiv:2211.17192, applied per-knob).
 """
 
 from __future__ import annotations
@@ -21,14 +27,22 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sample_one", "sample_tokens"]
+__all__ = [
+    "filtered_logits",
+    "modified_probs",
+    "sample_one",
+    "sample_tokens",
+    "speculative_verify",
+    "speculative_verify_tokens",
+]
 
 
-def sample_one(logits, key, temperature, top_k, top_p):
-    """Sample one token id from ``logits [vocab]``; every argument after
-    ``logits`` is a traced scalar.  Returns an int32 scalar."""
+def filtered_logits(logits, temperature, top_k, top_p):
+    """Temperature-scaled logits with the top-k / top-p mask applied
+    (masked-out entries are ``-inf``).  ``logits [vocab]``; knobs are traced
+    scalars.  This is the distribution-shaping half of :func:`sample_one`,
+    shared with the speculative accept/resample path."""
     vocab = logits.shape[-1]
-    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     # temperature-scaled working copy (guard the traced divide-by-zero even
     # though the greedy branch wins the final where)
@@ -54,7 +68,21 @@ def sample_one(logits, key, temperature, top_k, top_p):
     use_p = top_p < 1.0
     p_mask = jnp.where(use_p, scaled >= p_thresh, True)
 
-    masked = jnp.where(k_mask & p_mask, scaled, -jnp.inf)
+    return jnp.where(k_mask & p_mask, scaled, -jnp.inf)
+
+
+def modified_probs(logits, temperature, top_k, top_p):
+    """The *modified* distribution the sampler actually draws from:
+    ``softmax(filtered_logits(...))``.  The speculative acceptance test
+    compares draft and target under their modified distributions."""
+    return jax.nn.softmax(filtered_logits(logits, temperature, top_k, top_p))
+
+
+def sample_one(logits, key, temperature, top_k, top_p):
+    """Sample one token id from ``logits [vocab]``; every argument after
+    ``logits`` is a traced scalar.  Returns an int32 scalar."""
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    masked = filtered_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, masked).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy_tok)
 
@@ -63,3 +91,83 @@ def sample_tokens(logits, keys, temperature, top_k, top_p):
     """Vmapped :func:`sample_one` over a slot batch: ``logits [slots,
     vocab]``, ``keys [slots]`` PRNG keys, per-slot scalar knob arrays."""
     return jax.vmap(sample_one)(logits, keys, temperature, top_k, top_p)
+
+
+def speculative_verify(logits, drafts, draft_probs, key, temperature, top_k,
+                       top_p, speculate):
+    """Judge one slot's ``m``-token speculative window.
+
+    ``logits [m, vocab]`` are the target's logits where row ``i`` predicts
+    the position ``drafts[i]`` was proposed for; ``draft_probs [m, vocab]``
+    are the draft's *modified* distributions at those positions (same
+    temperature/top-k/top-p filtering).  ``speculate`` is a traced bool —
+    False collapses to the plain single-token path (sample row 0 exactly as
+    the non-speculative decode step would), so opted-out slots ride the same
+    program without semantic drift.
+
+    Returns ``(tokens [m], count, accepted, new_key)``: emit
+    ``tokens[:count]``; ``accepted`` counts kept draft tokens (the
+    proposed/accepted telemetry).  There is deliberately **no bonus token**:
+    on an all-accept window the emitted suffix is ``drafts`` itself, so the
+    draft model's own cache — which already holds K/V for every proposed
+    token — never develops a hole and needs no catch-up feeds.
+
+    Semantics per mode:
+
+    * greedy (``temperature <= 0``): accept while the draft matches the
+      target argmax; every emitted token is a target argmax row, so the
+      emitted stream is bitwise the non-speculative greedy stream.
+    * stochastic: Leviathan et al. acceptance-rejection — accept ``d_i``
+      with probability ``min(1, p(d_i)/q(d_i))``; on first rejection,
+      resample from ``normalize(max(p - q, 0))``.
+    """
+    m = logits.shape[0]
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    greedy_ok = drafts == targets
+
+    # Two key-split layouts share one input key: opted-out slots consume the
+    # same (key -> next_key, subkey) chain as the non-speculative engine, so
+    # a request's sampled tokens don't depend on its neighbours' opt-in.
+    next_plain, sub_plain = jax.random.split(key)
+    spec_keys = jax.random.split(key, 2 * m + 1)  # [next, m accepts, m resamples]
+
+    p = jax.vmap(modified_probs, in_axes=(0, None, None, None))(
+        logits, temperature, top_k, top_p)  # [m, vocab]
+    p_d = jnp.take_along_axis(p, drafts[:, None], axis=1)[:, 0]
+    q_d = jnp.take_along_axis(draft_probs, drafts[:, None], axis=1)[:, 0]
+    u = jax.vmap(lambda k: jax.random.uniform(k))(spec_keys[1:m + 1])
+    # u < p/q, written mult-form so q(d)=0 (never proposed, but numerically
+    # possible) accepts iff p(d) > 0 instead of dividing by zero
+    stoch_ok = u * q_d < p_d
+
+    residual = jnp.maximum(p - draft_probs, 0.0)
+    total = residual.sum(axis=-1, keepdims=True)
+    # p == q makes the residual empty — but then rejection has probability
+    # ~0; fall back to p so the categorical below stays well-defined
+    residual = jnp.where(total > 0, residual / total, p)
+    resampled = jax.vmap(
+        lambda k, pr: jax.random.categorical(k, jnp.log(pr))
+    )(spec_keys[m + 1:], residual).astype(jnp.int32)
+
+    ok = jnp.where(temperature > 0, stoch_ok, greedy_ok)
+    lead = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))  # leading accepts
+    count = jnp.minimum(lead + 1, m)  # +1 = the correction/final token
+    accepted = jnp.minimum(lead, count)
+    out = jnp.where(temperature > 0, jnp.where(ok, drafts, resampled), targets)
+
+    plain = sample_one(logits[0], sub_plain, temperature, top_k, top_p)
+    out = jnp.where(speculate, out, out.at[0].set(plain))
+    count = jnp.where(speculate, count, 1).astype(jnp.int32)
+    accepted = jnp.where(speculate, accepted, 0).astype(jnp.int32)
+    new_key = jnp.where(speculate, spec_keys[0], next_plain)
+    return out, count, accepted, new_key
+
+
+def speculative_verify_tokens(logits, drafts, draft_probs, keys, temperature,
+                              top_k, top_p, speculate):
+    """Vmapped :func:`speculative_verify` over the slot batch: ``logits
+    [slots, m, vocab]``, ``drafts [slots, m]``, ``draft_probs [slots, m,
+    vocab]``, per-slot keys/knobs/opt-in."""
+    return jax.vmap(speculative_verify)(
+        logits, drafts, draft_probs, keys, temperature, top_k, top_p,
+        speculate)

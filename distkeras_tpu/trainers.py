@@ -84,6 +84,24 @@ def _serving_twin(adapter: ModelAdapter) -> ModelAdapter:
     return adapter
 
 
+def _epoch_mean(stats, key):
+    """Per-epoch mean of ``stats[key]`` over its window axis, weighted by
+    per-window step counts when the streaming path recorded a ragged tail
+    (``window_steps``, :meth:`WindowedEngine.run_epoch_streaming`).  A
+    ragged tail window averages fewer steps than the full windows, so the
+    unweighted mean over-weights it; weighting by steps makes the epoch
+    mean match the in-memory path's mean over all steps.  Uniform windows
+    (and the in-memory path, which records no ``window_steps``) take the
+    plain ``np.mean`` branch so existing histories stay bitwise unchanged."""
+    values = np.asarray(stats[key])
+    weights = stats.get("window_steps") if isinstance(stats, dict) else None
+    if (weights is not None and values.ndim >= 1
+            and values.shape[0] == len(weights)
+            and int(np.min(weights)) != int(np.max(weights))):
+        return np.average(values, axis=0, weights=np.asarray(weights))
+    return np.mean(values, axis=0) if values.ndim > 1 else np.mean(values)
+
+
 class Trainer:
     """Base trainer: model + loss + worker optimizer + wall-clock bookkeeping
     (reference parity: ``trainers.py :: Trainer``)."""
@@ -502,10 +520,10 @@ class Trainer:
                 summary = telemetry.dynamics.summarize(dyn, loss=stats["loss"])
                 telemetry.dynamics.record(epoch_idx, dyn, summary)
             if scalar_log is not None:
-                scalars = {"loss": float(np.mean(stats["loss"]))}
+                scalars = {"loss": float(_epoch_mean(stats, "loss"))}
                 mets = np.asarray(stats["metrics"])
                 if mets.size:
-                    per_metric = np.mean(mets, axis=0)
+                    per_metric = _epoch_mean(stats, "metrics")
                     for i, name in enumerate(metrics):
                         key = name if isinstance(name, str) else getattr(name, "__name__", f"metric_{i}")
                         scalars[key] = float(per_metric[i])
@@ -598,6 +616,11 @@ class Trainer:
                             jax.block_until_ready(state.center_params)
                     else:
                         state, stats = run_one()
+                    ps = getattr(self, "parameter_server", None)
+                    if ps is not None:
+                        # live PS observability: copy the commit counter off
+                        # this epoch's state before the next dispatch donates it
+                        ps.track(getattr(state, "center_rule", None))
                     # keep the current epoch's stats as device arrays: dispatch
                     # is async, so the next epoch's host-side batching overlaps
                     # this epoch's device compute.  Materialise the previous
@@ -632,11 +655,11 @@ class Trainer:
         if average_at_end:
             state, _ = engine.average_workers(state)
 
-        losses_per_epoch = [float(np.mean(np.asarray(s["loss"]))) for s in epoch_stats]
+        losses_per_epoch = [float(_epoch_mean(s, "loss")) for s in epoch_stats]
         metrics_per_epoch = [
-            m for m in (np.asarray(s["metrics"]) for s in epoch_stats) if m.size
+            _epoch_mean(s, "metrics") for s in epoch_stats
+            if np.asarray(s["metrics"]).size
         ]
-        metrics_per_epoch = [np.mean(m, axis=0) for m in metrics_per_epoch]
         self.record_training_stop()
 
         self.history = {"loss": losses_per_epoch, "training_time": self.get_training_time()}

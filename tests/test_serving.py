@@ -288,9 +288,10 @@ def test_unservable_requests_rejected_loudly(lm, shared_engine):
 
 def test_one_compiled_decode_step_across_staggered_traffic(lm,
                                                            shared_engine):
-    """Acceptance: after one warmup request, arbitrary mixes of prompt
-    lengths, sampling knobs, and EOS must add ZERO jax compile/trace events
-    — admitting a request is data movement, never a retrace (DK102)."""
+    """Acceptance: after one warmup request per prefill bucket, arbitrary
+    mixes of prompt lengths, sampling knobs, and EOS must add ZERO jax
+    compile/trace events — admitting a request is data movement and a
+    bucket hit, never a retrace (DK102)."""
     module, params = lm
     install_jax_hooks()
     # a throwaway compile proves the hook is live (the counter only exists
@@ -298,7 +299,10 @@ def test_one_compiled_decode_step_across_staggered_traffic(lm,
     probe = jax.jit(lambda x: x + 1)
     probe(np.ones(3))
     engine = shared_engine
-    engine.generate([1, 2, 3], max_new_tokens=3, timeout=120)  # warmup
+    # warm every bucket the traffic below can hit (page_size=8 ladder:
+    # lengths <=8 -> bucket 8, lengths 9..16 -> bucket 16)
+    engine.generate([1, 2, 3], max_new_tokens=3, timeout=120)
+    engine.generate(list(range(1, 11)), max_new_tokens=3, timeout=120)
 
     base = telemetry.metrics.snapshot()["jax_compiles_total"]["value"]
     assert base >= 1
@@ -338,6 +342,11 @@ def test_serving_metrics_schema_golden():
     m["tokens"].inc(42)
     m["requests"].inc(5)
     m["rejected"].inc(1)
+    m["prefill_seconds"].observe(0.006)
+    m["prefill_padded"].inc(13)
+    m["decode_steps"].inc(17)
+    m["spec_proposed"].inc(24)
+    m["spec_accepted"].inc(19)
     golden = open(os.path.join(GOLDEN, "serving_metrics.txt")).read()
     assert registry.to_prometheus(labels={"run_id": "fleet1234"}) == golden
     # get-or-create: a second call must hand back the same instruments
@@ -418,32 +427,46 @@ def test_model_predictor_routes_through_engine(lm, shared_engine):
 
 
 _SERVE_SCRIPT = """\
+import json
 import time
 
-from distkeras_tpu import telemetry
+from distkeras_tpu import serving, telemetry
 
 telemetry.flightdeck.activate()
+with open("flags_out.json", "w") as f:
+    json.dump(serving.serve_flags(), f)  # prove the env round-trip
 time.sleep(120)  # a serving loop never exits; stop_serving terminates us
 """
 
 
 def test_daemon_serve_verb_lifecycle(tmp_path, monkeypatch):
     """``serve`` launches a detached long-running job with the flightdeck
-    forced on; ``serving_address`` discovers its exporter; ``stop_serving``
-    terminates it and the status flips to ``stopped``."""
+    forced on; ``serving_address`` discovers its exporter; engine knobs
+    passed as ``Job.serve(flags=...)`` reach the child via
+    ``DISTKERAS_SERVE_FLAGS`` / ``serving.serve_flags()`` and echo in the
+    status reply; ``stop_serving`` terminates it and the status flips to
+    ``stopped``."""
     from distkeras_tpu.job_deployment import Job, PunchcardServer
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     monkeypatch.setenv("PYTHONPATH", repo)
     server = PunchcardServer(port=0, secret="s3cret")
     server.start()
+    flags = {"spec_tokens": 3, "prefill_buckets": [8, 32], "num_slots": 2}
     try:
         job = Job("127.0.0.1", server.port, secret="s3cret",
                   script=_SERVE_SCRIPT)
-        assert job.serve()
+        assert job.serve(flags=flags)
         addr = job.serving_address(timeout=60)
         status, text = _get(addr, "/healthz")
         assert status == 200 and json.loads(text)["status"] == "ok"
+        assert job.status()["serve_flags"] == flags
+        flags_out = os.path.join(server.workdir, "flags_out.json")
+        deadline = time.monotonic() + 30
+        while not os.path.exists(flags_out) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        with open(flags_out) as f:
+            assert json.load(f) == flags  # the child saw the same knobs
         reply = job.stop_serving()
         assert reply == {"status": "stopped", "job_id": job.job_id}
         assert job.status()["status"] == "stopped"

@@ -262,3 +262,94 @@ def test_trainer_streaming_with_pipeline_matches_in_memory():
         return t.get_history()["loss"]
 
     np.testing.assert_array_equal(run(False), run(True))
+
+
+def test_streaming_ragged_tail_weighted_history(toy_classification):
+    """PARITY disclosure, fixed: a ragged tail window's loss is weighted by
+    its actual step count in the epoch mean, so the streamed history
+    matches the mean over all steps — while uniform windows keep the plain
+    (bitwise-unchanged) mean."""
+    import pytest
+
+    from distkeras_tpu.trainers import _epoch_mean
+
+    x, y, onehot = toy_classification
+    workers, batch, window = 4, 8, 3  # 16 steps -> windows 3,3,3,3,3,1
+    eng = _engine(workers)
+    state = eng.init_state(jax.random.PRNGKey(0), x[:batch])
+    blocks = epoch_window_iter(x, onehot, workers, batch, window,
+                               pad_to_window=False)
+    state, stats = eng.run_epoch_streaming(state, blocks)
+    stats = jax.tree.map(np.asarray, stats)
+
+    steps = stats["window_steps"]
+    assert steps.tolist() == [3, 3, 3, 3, 3, 1]
+    losses = np.asarray(stats["loss"], np.float64)
+    expected = np.average(losses, weights=steps)
+    assert float(_epoch_mean(stats, "loss")) == pytest.approx(expected,
+                                                              rel=1e-12)
+    # the unweighted mean over-weights the 1-step tail — the fixed bug
+    assert expected != pytest.approx(float(np.mean(losses)), rel=1e-9)
+    # uniform windows stay on the plain-mean branch, bitwise
+    uniform = dict(stats)
+    uniform["window_steps"] = np.full_like(steps, 3)
+    assert float(_epoch_mean(uniform, "loss")) == float(np.mean(stats["loss"]))
+    # the in-memory path records no window_steps: also plain mean
+    assert float(_epoch_mean({"loss": stats["loss"]}, "loss")) == float(
+        np.mean(stats["loss"]))
+
+
+class _SlowBlocks:
+    """Source iterator throttled to a fixed per-block latency — a stand-in
+    for a dataset behind a slow link."""
+
+    def __init__(self, blocks, latency):
+        self._blocks = blocks
+        self._latency = latency
+
+    def __iter__(self):
+        import time
+
+        for b in self._blocks:
+            time.sleep(self._latency)
+            yield b
+
+
+def test_streaming_link_guardrail_throttled_source(toy_classification):
+    """A source slower than compute is unhideable: the engine must say so
+    loudly (warn once; raise in strict mode) and record the verdict on
+    ``last_stream_report`` — while a fast source stays quiet."""
+    import pytest
+
+    x, y, onehot = toy_classification
+    workers, batch, window = 4, 8, 2  # 8 windows: well past prefetch depth
+    eng = _engine(workers)
+    state = eng.init_state(jax.random.PRNGKey(0), x[:batch])
+
+    def blocks():
+        return list(epoch_window_iter(x, onehot, workers, batch, window))
+
+    # warmup epoch compiles the window program; fast source -> quiet
+    state, _ = eng.run_epoch_streaming(state, blocks())
+    report = eng.last_stream_report
+    assert report is not None and report["windows"] == 8
+    assert not report["link_bound"]
+
+    with pytest.warns(RuntimeWarning, match="source is the bottleneck"):
+        state, _ = eng.run_epoch_streaming(state, _SlowBlocks(blocks(), 0.05))
+    report = eng.last_stream_report
+    assert report["link_bound"] and report["unhideable_fraction"] > 0.25
+    assert report["steady_source_seconds"] > 0
+
+    # warn-once: a second throttled epoch does not warn again
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error", RuntimeWarning)
+        state, _ = eng.run_epoch_streaming(state, _SlowBlocks(blocks(), 0.05))
+    assert eng.last_stream_report["link_bound"]
+
+    # strict mode escalates the same verdict to an error
+    with pytest.raises(RuntimeError, match="source is the bottleneck"):
+        eng.run_epoch_streaming(state, _SlowBlocks(blocks(), 0.05),
+                                strict_link=True)

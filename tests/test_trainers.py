@@ -89,6 +89,43 @@ def test_ensemble_trainer_returns_n_models(toy_classification):
     assert not np.allclose(p0, p1)
 
 
+def test_parameter_server_pollable_mid_train(toy_classification):
+    """Reference parity: the socket PS answered ``num_updates`` queries
+    WHILE training ran.  The facade must do the same — epoch boundaries
+    refresh a live device-side copy of the commit counter (the epoch state
+    itself is donated, so the facade cannot just hold a reference), and a
+    concurrent thread polling the trainer sees monotone, eventually
+    non-zero counts before ``train`` returns."""
+    import threading
+    import time
+
+    df = make_df(toy_classification)
+    t = dk.DOWNPOUR(model(), loss="categorical_crossentropy",
+                    worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                    num_workers=4, batch_size=16, num_epoch=20,
+                    communication_window=2)
+    samples, done = [], threading.Event()
+
+    def poll():
+        while not done.is_set():
+            ps = t.parameter_server
+            if ps is not None:
+                samples.append(ps.num_updates)
+            time.sleep(0.001)
+
+    poller = threading.Thread(target=poll)
+    poller.start()
+    try:
+        t.train(df)
+    finally:
+        done.set()
+        poller.join()
+    assert samples, "poller never saw the parameter server"
+    assert all(b >= a for a, b in zip(samples, samples[1:])), "counter regressed"
+    assert samples[-1] > 0  # observed live progress before train() returned
+    assert t.num_updates >= samples[-1]
+
+
 def test_downpour_determinism(toy_classification):
     """XLA collectives are deterministic — same seed, same result (the
     property the reference's hogwild PS could never have; SURVEY.md §5.2)."""
