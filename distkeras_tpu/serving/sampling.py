@@ -125,11 +125,16 @@ def speculative_verify(logits, drafts, draft_probs, key, temperature, top_k,
     targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     greedy_ok = drafts == targets
 
-    # Two key-split layouts share one input key: opted-out slots consume the
-    # same (key -> next_key, subkey) chain as the non-speculative engine, so
-    # a request's sampled tokens don't depend on its neighbours' opt-in.
+    # Opted-out slots consume the same (key -> next_key, subkey) chain as
+    # the non-speculative engine, so a request's sampled tokens don't depend
+    # on its neighbours' opt-in.  The speculative keys derive from the fresh
+    # subkey `next_plain` — never from `key` itself: under partitionable
+    # threefry (the default in newer JAX), split(key, n)[:2] == split(key),
+    # so re-splitting the parent would make the first accept-uniform reuse
+    # the plain sampling key exactly (correlated accept/resample streams —
+    # the DK111 lineage rule pins this).
     next_plain, sub_plain = jax.random.split(key)
-    spec_keys = jax.random.split(key, 2 * m + 1)  # [next, m accepts, m resamples]
+    spec_keys = jax.random.split(next_plain, 2 * m + 1)  # [next, m accepts, m resamples]
 
     p = jax.vmap(modified_probs, in_axes=(0, None, None, None))(
         logits, temperature, top_k, top_p)  # [m, vocab]

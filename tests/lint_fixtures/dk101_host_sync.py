@@ -38,3 +38,25 @@ class ToyEngine:
 
     def cold_path(self, stats):
         return np.asarray(stats)  # host-side helper: NOT flagged
+
+
+@jax.jit
+def rebound(x):
+    x = 0.0
+    return float(x)  # v3 provenance: rebound to a host constant, NOT flagged
+
+
+@jax.jit
+def still_traced(x):
+    x = x * 2.0
+    return float(x)  # DK101 — the rebound value still derives from traced x
+
+
+def sync_factory():
+    const = jnp.asarray(2.0)
+
+    @jax.jit
+    def step(a):
+        return a * const.item()  # closure constant: trace-time sync, NOT flagged
+
+    return step

@@ -50,3 +50,21 @@ clip = jax.vmap(clipped)
 struct = jax.jit(structural)
 stat = jax.jit(static_ok, static_argnums=(1,))
 sup = jax.jit(suppressed)
+
+
+def rebound_branch(x):
+    x = 0
+    if x > 0:  # v3 provenance: x rebound to a host constant, NOT flagged
+        return 1.0
+    return 0.0
+
+
+def derived_branch(x):
+    y = x * 2
+    if y > 0:  # DK109 — y still derives from the traced parameter
+        return y
+    return 0.0
+
+
+rb = jax.jit(rebound_branch)
+db = jax.jit(derived_branch)

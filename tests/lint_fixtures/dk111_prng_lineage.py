@@ -1,0 +1,69 @@
+"""DK111 fixture — PRNG key lineage violations and sanctioned idioms.
+
+Package-scoped rule: the test copies this file into a synthetic
+``distkeras_tpu`` package under tmp_path, so the line numbers below are
+asserted there (self-lint never sees findings for it here).  Keep edits
+append-only or update the test.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def double_split(key):
+    # the sampling.py:131-132 shape — one key split twice
+    next_key, sub = jax.random.split(key)           # line 14: first consume
+    spec = jax.random.split(key, 5)                 # line 15: DK111 (reuse)
+    return next_key, sub, spec
+
+
+def split_then_draw(key):
+    out = jax.random.split(key, 3)                  # line 20: first consume
+    u = jax.random.uniform(key)                     # line 21: DK111 (reuse)
+    return out, u
+
+
+def loop_reuse(key, n):
+    acc = 0.0
+    for _ in range(n):
+        acc += jax.random.uniform(key)              # line 28: DK111 (loop)
+    return acc
+
+
+def chained_ok(key):
+    key, sub = jax.random.split(key)                # fresh chain: clean
+    u = jax.random.uniform(sub)
+    key, sub = jax.random.split(key)
+    v = jax.random.uniform(sub)
+    return u + v
+
+
+def branches_ok(key, flag):
+    if flag:
+        return jax.random.uniform(key)              # exclusive arms: clean
+    return jax.random.normal(key)
+
+
+def fold_in_ok(key, n):
+    # deriving per-step streams via fold_in is the sanctioned idiom, and it
+    # coexists with one split of the same parent
+    subs = [jax.random.fold_in(key, i) for i in range(n)]
+    key, carry = jax.random.split(key)
+    return subs, carry
+
+
+def loop_advance_ok(key, n):
+    total = 0.0
+    for _ in range(n):
+        key, sub = jax.random.split(key)            # advanced per iter: clean
+        total += jax.random.uniform(sub)
+    return total
+
+
+def vmap_split_ok(keys):
+    return jax.vmap(jax.random.split)(keys)         # batched: not a Name arg
+
+
+def constructor_ok(seed):
+    # PRNGKey is a producer; consuming its result twice through a temp name
+    # is the bug, consuming a fresh construction inline is not
+    return jax.random.uniform(jax.random.PRNGKey(seed))

@@ -1,0 +1,64 @@
+"""DK112 fixture — blocking calls inside hot regions (and sanctioned forms).
+
+Not package-scoped, so the deliberate violations below also surface in the
+self-lint run — each carries a selflint_baseline.json entry.  Keep edits
+append-only or update the test.
+"""
+import threading
+import time
+
+import jax
+
+_lock = threading.Lock()
+
+
+@jax.jit
+def sleepy_step(x):
+    time.sleep(0.1)                     # line 17: DK112 (sleep in traced body)
+    return x * 2
+
+
+def hot_helper(sock, x):
+    data = sock.recv(1024)              # line 22: DK112 (socket in hot region)
+    return x, data
+
+
+@jax.jit
+def calls_helper(x):
+    return hot_helper(None, x)
+
+
+class ToyServingEngine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._queue = None
+
+    def _loop(self):
+        while True:
+            item = self._queue.get()    # line 38: DK112 (un-timed-out get)
+            _lock.acquire()             # line 39: DK112 (un-timed-out acquire)
+            self._step(item)
+
+    def _step(self, item):
+        with open("/tmp/x", "w") as f:  # line 43: DK112 (file I/O, hot via _loop)
+            f.write(str(item))
+
+
+def cold_path(sock):
+    time.sleep(0.5)                     # not hot: clean
+    return sock.recv(1)
+
+
+class PatientEngine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._queue = None
+
+    def _loop(self):
+        with self._cv:
+            self._cv.wait(timeout=0.05)         # bounded wait: clean
+        item = self._queue.get(timeout=1.0)     # bounded get: clean
+        if _lock.acquire(timeout=0.5):          # bounded acquire: clean
+            _lock.release()
+        flags = {}
+        return flags.get("a"), item             # dict.get(key): clean

@@ -1,0 +1,82 @@
+"""DK113 fixture — daemon protocol violations and the disciplined shapes.
+
+Package-scoped rule: the test copies this file into a synthetic
+``distkeras_tpu`` package under tmp_path and asserts the findings there.
+Keep edits append-only or update the test.
+"""
+import threading
+
+from distkeras_tpu.networking import recv_data, send_data
+
+
+class LeakyServer:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.jobs = {}
+
+    def _handle(self, conn):
+        msg = recv_data(conn)
+        action = msg.get("action")
+        if action == "submit":
+            job_id = "j1"
+            send_data(conn, {"status": "queued", "job_id": job_id})
+            send_data(conn, {"status": "queued"})       # double reply
+        elif action == "status":
+            job = self.jobs.get(msg.get("job_id"))
+            if job is not None:
+                send_data(conn, {"status": job})        # no reply when None
+        elif action == "drop":
+            self.jobs.clear()                           # never replies
+        # no else: unknown verbs fall through silently
+
+    def _broadcast(self, conn, payload):
+        with self._cv:
+            send_data(conn, payload)                    # socket I/O, cv held
+            self._cv.notify_all()
+
+
+class DisciplinedServer:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.jobs = {}
+
+    def _handle(self, conn):
+        msg = recv_data(conn)
+        action = msg.get("action")
+        if action == "submit":
+            with self._cv:
+                self.jobs["j1"] = msg
+                self._cv.notify()
+            send_data(conn, {"status": "queued"})       # send after release
+        elif action == "status":
+            job = self.jobs.get(msg.get("job_id"))
+            if job is None:
+                send_data(conn, {"status": "unknown"})
+            else:
+                send_data(conn, {"status": "ok"})
+        elif action == "fail":
+            raise RuntimeError("handled by the except story")  # raise exempt
+        else:
+            send_data(conn, {"status": "bad_request"})
+
+
+def register_endpoints(server):
+    def falls_off(request):
+        if request.get("ok"):
+            return ("application/json", "{}", 200)      # no else: None path
+
+    def bare_return(request):
+        if not request:
+            return                                      # bare return
+        return ("application/json", "{}", 200)
+
+    def disciplined(request):
+        try:
+            body = request["body"]
+        except KeyError:
+            return ("application/json", "{}", 400)
+        return ("application/json", body, 200)
+
+    server.add_endpoint("/a", falls_off)
+    server.add_endpoint("/b", bare_return)
+    server.add_endpoint("/c", disciplined)

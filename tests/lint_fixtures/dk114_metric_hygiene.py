@@ -1,0 +1,34 @@
+"""DK114 fixture — metric-name hygiene violations against a golden set.
+
+Package-scoped rule: the test copies this file into a synthetic
+``distkeras_tpu`` package under tmp_path alongside a
+``tests/golden/fixture_metrics.txt`` pinning::
+
+    # TYPE serving_widget_latency_seconds histogram
+    # TYPE serving_widgets_total counter
+
+Keep edits append-only or update the test.
+"""
+
+
+def register(registry):
+    # near-miss of the golden serving_widgets_total (edit distance 1)
+    registry.counter("serving_widget_total", help="typo'd twin")
+    # kind conflict with the golden histogram
+    registry.gauge("serving_widget_latency_seconds", help="latency")
+    # duplicate name, conflicting kind (counter below, gauge here)
+    registry.gauge("fixture_inflight_requests", help="in flight")
+    return registry
+
+
+def register_again(registry):
+    registry.counter("fixture_inflight_requests", help="in flight")
+    # same name + same kind + same help re-registered: idempotent, clean
+    registry.gauge("fixture_admission_depth", help="queue depth")
+    registry.gauge("fixture_admission_depth", help="queue depth")
+    # exact golden match, right kind: clean (golden names are ground truth,
+    # so the typo'd twin above never drags this one into near-miss)
+    registry.counter("serving_widgets_total", help="widgets served")
+    # short names never near-miss: clean
+    registry.gauge("up", help="liveness")
+    return registry

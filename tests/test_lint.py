@@ -41,6 +41,7 @@ def test_dk101_host_sync_fixture():
         ("DK101", 19),  # jax.device_get
         ("DK101", 25),  # block_until_ready in scan body
         ("DK101", 37),  # .item() in engine hot method
+        ("DK101", 52),  # float() on x = x * 2.0 — still param-derived
     ]
 
 
@@ -50,6 +51,16 @@ def test_dk101_suppression_and_cold_paths():
     assert 20 not in lines  # trailing `# dklint: disable=DK101`
     assert 36 not in lines  # float() on a local int, not a traced arg
     assert 40 not in lines  # np.asarray outside any hot path
+
+
+def test_dk101_v3_provenance_kills_reassignment_fps():
+    """The v2 false-positive class: a parameter rebound to a host constant
+    (``x = 0.0; float(x)``) and a closure constant synced inside a jitted
+    factory product are trace-time constants, not per-step syncs."""
+    got, _ = _run("dk101_host_sync.py", ["DK101"])
+    lines = [ln for _, ln in got]
+    assert 46 not in lines  # float(x) after x = 0.0 rebind
+    assert 60 not in lines  # const.item() on an enclosing-factory constant
 
 
 def test_dk102_recompile_fixture():
@@ -187,6 +198,7 @@ def test_dk109_traced_branch_fixture():
         ("DK109", 8),   # if on traced param of jit-by-name fn
         ("DK109", 14),  # while on traced param 'x'
         ("DK109", 14),  # ... and on traced param 'lo'
+        ("DK109", 64),  # if on y = x * 2 — still param-derived
     ]
 
 
@@ -199,6 +211,7 @@ def test_dk109_exemptions_and_suppression():
     assert 30 not in lines  # static_argnums at the jit call site
     assert 36 not in lines  # suppressed
     assert 43 not in lines  # @jax.jit-decorated fn is DK102's territory
+    assert 57 not in lines  # v3: branch on x after x = 0 rebind is host flow
 
 
 def _run_dk110(tmp_path):
@@ -234,6 +247,146 @@ def test_dk110_out_of_package_is_silent():
     # tools/ and tests/ keep their CLIs and fixtures
     got, _ = _run("dk110_print_logging.py", ["DK110"])
     assert got == []
+
+
+def _run_in_package(tmp_path, fixture, select, golden=None):
+    """Package-scoped rules (DK111/DK113/DK114) are exercised from a
+    synthetic ``distkeras_tpu`` package root, like ``_run_dk110``.  When
+    ``golden`` is given it is written to tests/golden/fixture_metrics.txt
+    under the same root so DK114 sees it as the exported ground truth."""
+    src = open(os.path.join(FIXTURES, fixture)).read()
+    pkg = tmp_path / "distkeras_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(src)
+    if golden is not None:
+        gd = tmp_path / "tests" / "golden"
+        gd.mkdir(parents=True)
+        (gd / "fixture_metrics.txt").write_text(golden)
+    findings, _ = analyze([str(pkg / "mod.py")], root=str(tmp_path),
+                          select=select)
+    return [(f.rule, f.line) for f in findings]
+
+
+def test_dk111_prng_lineage_fixture(tmp_path):
+    assert _run_in_package(tmp_path, "dk111_prng_lineage.py", ["DK111"]) == [
+        ("DK111", 15),  # second split of the same key (the sampling.py bug)
+        ("DK111", 21),  # split then a draw from the already-consumed parent
+        ("DK111", 28),  # key consumed in a loop but never advanced there
+    ]
+
+
+def test_dk111_clean_lineages_are_silent(tmp_path):
+    lines = [ln for _, ln in
+             _run_in_package(tmp_path, "dk111_prng_lineage.py", ["DK111"])]
+    assert 35 not in lines and 36 not in lines  # key rebound between draws
+    assert 42 not in lines and 43 not in lines  # exclusive if/else arms
+    assert 49 not in lines and 50 not in lines  # fold_in + one split coexist
+    assert 57 not in lines and 58 not in lines  # key advanced per iteration
+    assert 63 not in lines  # vmapped split: not a Name-keyed consumption
+    assert 69 not in lines  # inline PRNGKey construction consumed once
+
+
+def test_dk111_out_of_package_is_silent():
+    got, _ = _run("dk111_prng_lineage.py", ["DK111"])
+    assert got == []
+
+
+def test_dk112_blocking_fixture():
+    got, _ = _run("dk112_blocking.py", ["DK112"])
+    assert got == [
+        ("DK112", 17),  # time.sleep in a jitted step
+        ("DK112", 22),  # sock.recv in a helper reachable from the jit
+        ("DK112", 38),  # untimed queue.get() in the engine decode loop
+        ("DK112", 39),  # untimed lock.acquire() in the decode loop
+        ("DK112", 43),  # open() in a method the decode loop calls
+    ]
+
+
+def test_dk112_cold_and_timed_calls_are_silent():
+    got, _ = _run("dk112_blocking.py", ["DK112"])
+    lines = [ln for _, ln in got]
+    assert 48 not in lines and 49 not in lines  # cold function: clean
+    assert 59 not in lines  # cv.wait(timeout=...) is bounded
+    assert 60 not in lines  # queue.get(timeout=...) is bounded
+    assert 61 not in lines  # lock.acquire(timeout=...) is bounded
+    assert 64 not in lines  # dict.get(key) is not queue.get()
+
+
+def test_dk113_daemon_protocol_fixture(tmp_path):
+    assert _run_in_package(
+        tmp_path, "dk113_daemon_protocol.py", ["DK113"]
+    ) == [
+        ("DK113", 20),  # verb 'submit': double reply on one path
+        ("DK113", 20),  # dispatch chain has no else leg
+        ("DK113", 24),  # verb 'status': replies on some paths only
+        ("DK113", 28),  # verb 'drop': never replies
+        ("DK113", 34),  # send_data while holding self._cv
+        ("DK113", 64),  # endpoint falls off the end
+        ("DK113", 70),  # bare return in an endpoint handler
+    ]
+
+
+def test_dk113_disciplined_server_is_silent(tmp_path):
+    lines = [ln for _, ln in _run_in_package(
+        tmp_path, "dk113_daemon_protocol.py", ["DK113"])]
+    # DisciplinedServer (single reply per verb, send after releasing the cv,
+    # raise path exempt, else leg present) spans lines 38-60; the
+    # disciplined try/except endpoint spans 73-78 — all silent
+    assert not any(38 <= ln <= 60 for ln in lines)
+    assert not any(73 <= ln <= 78 for ln in lines)
+
+
+_DK114_GOLDEN = (
+    "# HELP serving_widget_latency_seconds latency\n"
+    "# TYPE serving_widget_latency_seconds histogram\n"
+    "# HELP serving_widgets_total widgets\n"
+    "# TYPE serving_widgets_total counter\n"
+)
+
+
+def test_dk114_metric_hygiene_fixture(tmp_path):
+    assert _run_in_package(
+        tmp_path, "dk114_metric_hygiene.py", ["DK114"], golden=_DK114_GOLDEN
+    ) == [
+        ("DK114", 16),  # near-miss of golden serving_widgets_total
+        ("DK114", 18),  # gauge vs the golden histogram kind
+        ("DK114", 25),  # later-site kind conflict with the line-20 gauge
+    ]
+
+
+def test_dk114_clean_registrations_are_silent(tmp_path):
+    lines = [ln for _, ln in _run_in_package(
+        tmp_path, "dk114_metric_hygiene.py", ["DK114"],
+        golden=_DK114_GOLDEN)]
+    assert 27 not in lines and 28 not in lines  # idempotent re-registration
+    assert 31 not in lines  # exact golden match is ground truth, not a typo
+    assert 33 not in lines  # short names never near-miss
+
+
+def test_dk114_label_disagreement_across_goldens(tmp_path):
+    src = (
+        "def register(registry):\n"
+        '    registry.counter("fixture_rpc_calls_total", help="rpcs")\n'
+    )
+    pkg = tmp_path / "distkeras_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(src)
+    gd = tmp_path / "tests" / "golden"
+    gd.mkdir(parents=True)
+    (gd / "a_metrics.txt").write_text(
+        "# TYPE fixture_rpc_calls_total counter\n"
+        'fixture_rpc_calls_total{run_id="x"} 1\n'
+    )
+    (gd / "b_metrics.txt").write_text(
+        "# TYPE fixture_rpc_calls_total counter\n"
+        'fixture_rpc_calls_total{run_id="x",verb="submit"} 1\n'
+    )
+    findings, _ = analyze([str(pkg / "mod.py")], root=str(tmp_path),
+                          select=["DK114"])
+    assert len(findings) == 1
+    assert "disagree on label keys" in findings[0].message
 
 
 # ------------------------------------------------------ interprocedural v2
@@ -352,7 +505,7 @@ def test_baseline_cancels_and_reports_stale(tmp_path):
 def test_all_rules_registered():
     assert sorted(all_rules()) == [
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
-        "DK108", "DK109", "DK110",
+        "DK108", "DK109", "DK110", "DK111", "DK112", "DK113", "DK114",
     ]
 
 
@@ -480,3 +633,86 @@ def test_cli_json_format():
     )
     payload = json.loads(out.stdout)
     assert [f["rule"] for f in payload] == ["DK104"] * 3
+
+
+def test_cli_sarif_format_roundtrip():
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dklint",
+         os.path.join("tests", "lint_fixtures", "dk104_mesh_axes.py"),
+         "--no-baseline", "--root", REPO_ROOT, "--format", "sarif"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert out.returncode == 1
+    doc = json.loads(out.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "dklint"
+    # every registered rule is described even though only DK104 fired
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(all_rules())
+    results = run["results"]
+    assert [r["ruleId"] for r in results] == ["DK104"] * 3
+    for r in results:
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == \
+            "tests/lint_fixtures/dk104_mesh_axes.py"
+        assert loc["region"]["startLine"] > 0
+        assert loc["region"]["startColumn"] > 0  # SARIF columns are 1-based
+        assert r["message"]["text"]
+
+
+def _git(cwd, *args):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, capture_output=True, text=True, check=True,
+    )
+
+
+def test_cli_since_filters_to_changed_files(tmp_path):
+    """--since reports only findings in files changed vs. the ref, while
+    still analyzing the whole tree (so cross-module facts stay correct)."""
+    _git(tmp_path, "init", "-q")
+    old = tmp_path / "old.py"
+    old.write_text(
+        "import jax\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n"
+    )
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    new = tmp_path / "new.py"
+    new.write_text(
+        "import jax\ndef g(x):\n    return jax.jit(lambda v: v)(x)\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", ".", "--no-baseline",
+         "--root", str(tmp_path), "--since", "HEAD", "--format", "json"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    # old.py's finding pre-dates the ref and is filtered; untracked new.py
+    # counts as changed
+    assert [(f["path"], f["rule"]) for f in payload] == [("new.py", "DK102")]
+    # with everything committed, the diff set is empty -> clean exit
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "more")
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", ".", "--no-baseline",
+         "--root", str(tmp_path), "--since", "HEAD"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+
+def test_cli_since_bad_ref_is_usage_error(tmp_path):
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", ".", "--no-baseline",
+         "--root", str(tmp_path), "--since", "no-such-ref"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+    )
+    assert out.returncode == 2
+    assert "--since" in out.stderr

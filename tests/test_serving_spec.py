@@ -189,6 +189,90 @@ def test_speculative_verify_faithful_draft_accepts_all_stochastic():
     assert out.tolist() == drafts.tolist()
 
 
+def test_spec_key_derivation_decorrelated_from_plain_chain():
+    """Regression pin for the key-lineage fix: the speculative keys derive
+    from the fresh ``next_plain`` subkey, never from the parent ``key``.
+    Under partitionable threefry (the default in newer JAX) the old
+    derivation collided *exactly* — ``split(key, 2m+1)[:2] == split(key)``,
+    so the first accept-uniform reused the plain sampling key."""
+    m = 3
+    was = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        key = jax.random.PRNGKey(42)
+        wide = np.asarray(jax.random.split(key, 2 * m + 1))
+        pair = np.asarray(jax.random.split(key))
+        # the hazard the old code sat on
+        assert np.array_equal(wide[:2], pair)
+        # the fixed derivation shares no key with anything split off the
+        # parent directly
+        fixed = np.asarray(jax.random.split(jax.random.split(key)[0],
+                                            2 * m + 1))
+        parent_derived = {tuple(k) for k in wide} | {tuple(k) for k in pair}
+        assert all(tuple(k) not in parent_derived for k in fixed)
+    finally:
+        jax.config.update("jax_threefry_partitionable", was)
+    # same disjointness under this build's default threefry
+    key = jax.random.PRNGKey(42)
+    wide = np.asarray(jax.random.split(key, 2 * m + 1))
+    pair = np.asarray(jax.random.split(key))
+    fixed = np.asarray(jax.random.split(jax.random.split(key)[0], 2 * m + 1))
+    parent_derived = {tuple(k) for k in wide} | {tuple(k) for k in pair}
+    assert all(tuple(k) not in parent_derived for k in fixed)
+
+
+def test_spec_and_plain_key_chains_diverge():
+    """The spec-path ``new_key`` must differ from the opt-out path's for
+    the same input key — pre-fix, under partitionable threefry, they were
+    the same key, so a request toggling speculation replayed its stream."""
+    was = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(2, 7)).astype(np.float32))
+        drafts = jnp.asarray(np.asarray(logits).argmax(-1), jnp.int32)
+        q = jnp.full((2, 7), 1.0 / 7, jnp.float32)
+        args = (logits, drafts, q, jax.random.PRNGKey(5),
+                jnp.float32(0.9), jnp.int32(0), jnp.float32(1.0))
+        *_, k_spec = speculative_verify(*args, jnp.asarray(True))
+        *_, k_plain = speculative_verify(*args, jnp.asarray(False))
+        assert not np.array_equal(np.asarray(k_spec), np.asarray(k_plain))
+    finally:
+        jax.config.update("jax_threefry_partitionable", was)
+
+
+def test_speculative_sampling_preserves_target_distribution():
+    """Acceptance for the corrected sampler: with a deliberately wrong
+    draft distribution q != p, the emitted-token marginal still equals the
+    target p (the accept/resample identity) — measured over 4096 key
+    chains with m=1."""
+    n = 4096
+    v = 5
+    rng = np.random.default_rng(13)
+    logits = jnp.asarray(rng.normal(size=(1, v)).astype(np.float32))
+    temp = 1.0
+    p = np.asarray(modified_probs(logits[0], jnp.float32(temp),
+                                  jnp.int32(0), jnp.float32(1.0)))
+    # a skewed draft distribution, nothing like p
+    q = np.asarray([0.70, 0.15, 0.05, 0.05, 0.05], np.float32)
+    drafts = rng.choice(v, size=(n, 1), p=q / q.sum()).astype(np.int32)
+    keys = jax.random.split(jax.random.PRNGKey(14), n)
+
+    verify = jax.vmap(
+        speculative_verify,
+        in_axes=(None, 0, None, 0, None, None, None, None))
+    tokens, counts, _, _ = verify(
+        logits, jnp.asarray(drafts), jnp.asarray(np.tile(q, (1, 1))),
+        keys, jnp.float32(temp), jnp.int32(0), jnp.float32(1.0),
+        jnp.asarray(True))
+    counts = np.asarray(counts)
+    assert (counts >= 1).all()  # m=1 always emits: accept or correction
+    first = np.asarray(tokens)[:, 0]
+    freq = np.bincount(first, minlength=v) / n
+    # per-bin std is sqrt(p(1-p)/n) <= 0.008; 0.035 is > 4 sigma
+    np.testing.assert_allclose(freq, p, atol=0.035)
+
+
 # ------------------------------------------------------------ parity pins
 
 

@@ -11,10 +11,13 @@ for flags).  Rules:
   DK105 off-lock-mutation       — guarded attributes written without the lock
 
 Programmatic surface: :func:`analyze`, :func:`apply_baseline`,
-:func:`load_baseline`, :class:`Finding`, and the registry in
-:mod:`tools.dklint.registry` for adding checkers.
+:func:`load_baseline`, :class:`Finding`, the registry in
+:mod:`tools.dklint.registry` for adding checkers, and the v3 dataflow
+layer (:mod:`tools.dklint.dataflow`: per-function CFG, reaching
+definitions, provenance) that DK101/DK109/DK111/DK112 are built on.
 """
 
+from tools.dklint import dataflow  # noqa: F401
 from tools.dklint.core import (  # noqa: F401
     Checker,
     Finding,
@@ -23,4 +26,5 @@ from tools.dklint.core import (  # noqa: F401
     load_baseline,
     save_baseline,
 )
+from tools.dklint.dataflow import function_flow, tainted_uses  # noqa: F401
 from tools.dklint.registry import all_rules, register  # noqa: F401

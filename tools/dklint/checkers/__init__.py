@@ -1,13 +1,17 @@
 """Importing this package registers every shipped checker."""
 
 from tools.dklint.checkers import (  # noqa: F401 — registration side effects
+    blocking,
     collectives,
+    daemon_protocol,
     donation,
     finiteness,
     host_sync,
     locks,
     mesh_axes,
+    metric_hygiene,
     printlog,
+    prng_lineage,
     recompile,
     traced_branch,
     wallclock,

@@ -1,0 +1,168 @@
+"""DK112 — blocking call inside a hot region (traced body or serving loop).
+
+The serving decode loop dispatches one device step every few milliseconds;
+a ``time.sleep``, an un-timed-out ``queue.get()``/``lock.acquire()``, a
+socket round-trip, or file I/O anywhere in that loop (or in a function it
+calls, however many hops away) stalls every active request in the batch —
+the tail-latency failure mode async serving systems die from.  Inside a
+*traced* body the same calls are worse: they run at trace time, silently,
+once per recompile.
+
+"Hot region" = DK101's ``global_hot_functions`` closure (jit-decorated,
+passed to tracing wrappers, engine step loops, everything they reach)
+**plus** the serving host loop — the ``_loop`` method of ``*Engine``
+classes and everything reachable from it, closed over the same
+cross-module call fixpoint (:func:`propagate_hot`).
+
+Timeout-bounded waits are the sanctioned idiom and stay legal:
+``cv.wait(timeout=...)``, ``q.get(timeout=...)`` / ``q.get(block=False)``,
+``lock.acquire(timeout=...)`` / ``acquire(blocking=False)``.
+``dict.get(key)`` never collides with ``queue.get()`` because only the
+zero-argument form is flagged.
+
+Runtime twin: the lockwatch sanitizer (hold-time warnings) and the
+flightdeck step-latency histograms catch what this rule misses at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+from tools.dklint.checkers.host_sync import (
+    FACTS_KEY,
+    global_hot_functions,
+    propagate_hot,
+)
+
+HOT112_KEY = "DK112.hot"
+
+# socket-object methods (attribute calls) that block on the network
+SOCKET_METHODS = frozenset({
+    "recv", "recv_into", "recvfrom", "accept", "sendall", "sendto", "send",
+    "connect",
+})
+
+
+def _has_kwarg(node: ast.Call, *names: str) -> bool:
+    return any(kw.arg in names for kw in node.keywords)
+
+
+def _nonblocking_flag(node: ast.Call) -> bool:
+    """``acquire(blocking=False)`` / ``get(block=False)`` style opt-outs
+    (also the positional ``acquire(False)`` form)."""
+    for kw in node.keywords:
+        if kw.arg in ("blocking", "block") and isinstance(kw.value, ast.Constant):
+            if kw.value.value is False:
+                return True
+    if node.args and isinstance(node.args[0], ast.Constant):
+        if node.args[0].value is False:
+            return True
+    return False
+
+
+def _serving_loop_seeds(project: Project) -> Set[int]:
+    """``_loop`` methods of ``*Engine`` classes — the serving host loop is
+    hot for latency reasons even though it is never traced."""
+    seeds: Set[int] = set()
+    for facts in project.data.get(FACTS_KEY, {}).values():
+        index = facts["index"]
+        for fn in index.fns:
+            if (
+                id(fn) in index.in_engine_class
+                and getattr(fn, "name", "") == "_loop"
+            ):
+                seeds.add(id(fn))
+    return seeds
+
+
+def hot_regions(project: Project) -> Set[int]:
+    """DK101's global hot closure plus the serving loop closure (memoized)."""
+    cached = project.data.get(HOT112_KEY)
+    if cached is not None:
+        return cached
+    seeds = set(global_hot_functions(project)) | _serving_loop_seeds(project)
+    hot = propagate_hot(project, seeds)
+    project.data[HOT112_KEY] = hot
+    return hot
+
+
+@register
+class BlockingCallChecker(Checker):
+    rule = "DK112"
+    name = "blocking-call-in-hot-region"
+    description = (
+        "time.sleep/socket I/O/file I/O/un-timed-out acquire()/get()/wait() "
+        "inside a traced body or the serving decode loop"
+    )
+
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        # DK101's collect already stores the facts this rule reads; nothing
+        # extra per file, but keep the hook so rule selection including only
+        # DK112 still populates FACTS_KEY
+        from tools.dklint.checkers.host_sync import _file_facts
+
+        project.data.setdefault(FACTS_KEY, {})[fi.relpath] = _file_facts(fi)
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        hot = hot_regions(project)
+        for fn in ast.walk(fi.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if id(fn) not in hot:
+                continue
+            yield from self._check_body(fi, fn)
+
+    def _check_body(self, fi: FileInfo, fn: ast.AST) -> Iterable[Finding]:
+        nested: Set[int] = set()
+        for child in ast.walk(fn):
+            if child is not fn and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                nested.update(id(s) for s in ast.walk(child))
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Call):
+                continue
+            why = self._blocking_reason(node, fi)
+            if why is not None:
+                yield Finding(
+                    path=fi.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.rule,
+                    message=f"blocking call in hot region: {why}",
+                )
+
+    def _blocking_reason(self, node: ast.Call, fi: FileInfo) -> Optional[str]:
+        name = call_name(node) or ""
+        head, _, rest = name.partition(".")
+        resolved = fi.imports.get(head)
+        if resolved:
+            name = resolved + ("." + rest if rest else "")
+        if name == "time.sleep":
+            return "time.sleep stalls the loop for the full duration"
+        if name == "open":
+            return "file I/O (open) blocks on the host filesystem"
+        # the project's length-prefixed socket framing pair, however imported
+        if name.rpartition(".")[2] in ("send_data", "recv_data"):
+            return f"socket framing {name.rpartition('.')[2]} blocks on the peer"
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        attr = node.func.attr
+        if attr in SOCKET_METHODS:
+            return f".{attr}() blocks on the network"
+        if attr == "acquire":
+            if _has_kwarg(node, "timeout") or _nonblocking_flag(node):
+                return None
+            return ".acquire() with no timeout can block indefinitely"
+        if attr == "wait":
+            if _has_kwarg(node, "timeout") or node.args:
+                return None
+            return ".wait() with no timeout can block indefinitely"
+        if attr == "get":
+            if node.args or _has_kwarg(node, "timeout") or _nonblocking_flag(node):
+                return None  # dict.get(key) / q.get(timeout=...) are fine
+            return ".get() with no timeout can block indefinitely"
+        return None

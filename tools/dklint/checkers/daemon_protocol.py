@@ -1,0 +1,457 @@
+"""DK113 — daemon protocol discipline for verb handlers and HTTP endpoints.
+
+The punchcard daemon speaks a framed request/response protocol: a client
+sends one verb, the server replies **exactly once**, the connection
+closes.  A verb branch that replies twice desynchronises the framing for
+every later exchange on a pooled connection; a branch that never replies
+leaves the client blocked in ``recv_data`` forever; an unhandled verb that
+falls through silently does the same.  The HTTP side has the twin
+discipline: an endpoint handler must *return* a response tuple on every
+path — falling off the end hands ``None`` to the exporter, a 500 with no
+body.  And neither side may hold the daemon's condition variable across
+socket I/O: a slow peer would then stall every thread that touches the cv
+(the serving loop included).
+
+Statically enforced, per function:
+
+  * **verb handlers** — functions that call both ``recv_data`` and
+    ``send_data``.  Their verb dispatch (an ``if``/``elif`` chain
+    comparing one subject against string constants) is analyzed per
+    branch: every exception-free path must contain exactly one
+    ``send_data``; ``raise`` paths are exempt (the except/finally story
+    owns those); a chain with no ``else`` is a silent-fall-through verb.
+  * **endpoint handlers** — functions registered via ``add_endpoint(...)``
+    (or any ``*route*`` registrar): every path must end in an explicit
+    ``return <value>``.
+  * **cv-held I/O** — no ``send_data``/``recv_data``/socket-method call
+    lexically inside ``with self.<lock>:`` where ``<lock>`` is assigned
+    from a lock factory anywhere in the file, including wrapped factories
+    (``lockwatch.maybe_wrap(threading.Condition(), ...)``).
+
+Scope: modules under ``distkeras_tpu``.  Runtime twin: lockwatch's
+hold-time warnings cover the cv-held case; reply-count discipline is
+static-only (a missing reply manifests as a client hang, not an error).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+from tools.dklint.checkers.blocking import SOCKET_METHODS
+
+LOCK_FACTORIES = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+})
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# counts above this are all reported as "several" — keeps the path-count
+# sets tiny on pathological inputs
+_CAP = 3
+
+
+def _is_send(node: ast.Call) -> bool:
+    name = call_name(node) or ""
+    return name.rpartition(".")[2] == "send_data"
+
+
+def _sends_in(node: Optional[ast.AST]) -> int:
+    """send_data calls in a subtree, not descending into nested defs
+    (their sends run when *they* are called, not on this path)."""
+    if node is None:
+        return 0
+    n = 0
+    stack: List[ast.AST] = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, _FN_NODES) and cur is not node:
+            continue
+        if isinstance(cur, ast.Call) and _is_send(cur):
+            n += 1
+        stack.extend(ast.iter_child_nodes(cur))
+    return n
+
+
+class _PathCounts:
+    """send_data counts over the exception-free paths of a statement list.
+
+    ``fall`` — counts of paths that run off the end of the list;
+    ``done`` — counts of paths that left via ``return``;
+    ``precise`` — False when a send sits somewhere this structural
+    analysis cannot count (inside a loop or a try body), in which case the
+    caller must not flag.
+    """
+
+    __slots__ = ("fall", "done", "precise")
+
+    def __init__(self, fall: Set[int], done: Set[int], precise: bool):
+        self.fall = fall
+        self.done = done
+        self.precise = precise
+
+
+def _cap(counts: Set[int]) -> Set[int]:
+    return {min(c, _CAP) for c in counts}
+
+
+def _count_block(stmts: List[ast.stmt]) -> _PathCounts:
+    fall: Set[int] = {0}
+    done: Set[int] = set()
+    precise = True
+    for stmt in stmts:
+        if not fall:
+            break  # everything below is unreachable on exception-free paths
+        sub = _count_stmt(stmt)
+        precise = precise and sub.precise
+        done |= _cap({f + d for f in fall for d in sub.done})
+        fall = _cap({f + s for f in fall for s in sub.fall})
+    return _PathCounts(fall, done, precise)
+
+
+def _count_stmt(stmt: ast.stmt) -> _PathCounts:
+    if isinstance(stmt, ast.Return):
+        return _PathCounts(set(), {_sends_in(stmt.value)}, True)
+    if isinstance(stmt, ast.Raise):
+        return _PathCounts(set(), set(), True)  # raise paths are exempt
+    if isinstance(stmt, ast.If):
+        body = _count_block(stmt.body)
+        other = _count_block(stmt.orelse)
+        test = _sends_in(stmt.test)
+        return _PathCounts(
+            _cap({test + c for c in body.fall | other.fall}),
+            _cap({test + c for c in body.done | other.done}),
+            body.precise and other.precise,
+        )
+    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+        body = _count_block(stmt.body)
+        tail = _count_block(stmt.orelse)
+        # a send inside a loop body runs 0..n times — uncountable here
+        precise = (
+            body.precise and tail.precise
+            and not any(_sends_in(s) for s in stmt.body)
+        )
+        return _PathCounts(tail.fall, body.done | tail.done, precise)
+    if isinstance(stmt, ast.Try):
+        body = _count_block(stmt.body + stmt.orelse)
+        fall, done = set(body.fall), set(body.done)
+        # a handler path is some prefix of the body plus the handler — the
+        # prefix's send count is only knowable when the body sends nothing
+        body_sends = any(_sends_in(s) for s in stmt.body + stmt.orelse)
+        precise = body.precise and not (body_sends and stmt.handlers)
+        for handler in stmt.handlers:
+            h = _count_block(handler.body)
+            precise = precise and h.precise
+            fall |= h.fall
+            done |= h.done
+        if stmt.finalbody:
+            tail = _count_block(stmt.finalbody)
+            precise = precise and tail.precise and not any(
+                _sends_in(s) for s in stmt.finalbody
+            )
+            if not tail.fall:  # finally that always leaves: nothing falls
+                fall = set()
+        return _PathCounts(fall, done, precise)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        head = sum(_sends_in(item.context_expr) for item in stmt.items)
+        body = _count_block(stmt.body)
+        return _PathCounts(
+            _cap({head + c for c in body.fall}),
+            _cap({head + c for c in body.done}),
+            body.precise,
+        )
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return _PathCounts({0}, set(), True)  # nested def: body deferred
+    return _PathCounts({min(_sends_in(stmt), _CAP)}, set(), True)
+
+
+def _dispatch_subject(test: ast.AST) -> Optional[Tuple[str, str]]:
+    """(subject source, verb string) for ``subject == "verb"`` tests (and
+    ``subject in ("a", "b")``)."""
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+        return None
+    left, op, right = test.left, test.ops[0], test.comparators[0]
+    subject = ast.dump(left)
+    if isinstance(op, ast.Eq):
+        if isinstance(right, ast.Constant) and isinstance(right.value, str):
+            return subject, right.value
+        if isinstance(left, ast.Constant) and isinstance(left.value, str):
+            return ast.dump(right), left.value
+    if isinstance(op, ast.In) and isinstance(right, (ast.Tuple, ast.List, ast.Set)):
+        verbs = [
+            el.value for el in right.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, str)
+        ]
+        if verbs and len(verbs) == len(right.elts):
+            return subject, "/".join(verbs)
+    return None
+
+
+def _verb_chain(stmt: ast.If) -> Optional[List[Tuple[str, ast.If, Optional[List[ast.stmt]]]]]:
+    """Decompose an if/elif chain whose every test is a string compare of
+    one common subject.  Returns [(verb, branch If node, None)] plus a
+    final ("<else>", chain head, else body) entry when an else exists."""
+    out: List[Tuple[str, ast.If, Optional[List[ast.stmt]]]] = []
+    subject: Optional[str] = None
+    cur: ast.stmt = stmt
+    while isinstance(cur, ast.If):
+        parsed = _dispatch_subject(cur.test)
+        if parsed is None:
+            return None
+        subj, verb = parsed
+        if subject is None:
+            subject = subj
+        elif subj != subject:
+            return None
+        out.append((verb, cur, None))
+        if len(cur.orelse) == 1 and isinstance(cur.orelse[0], ast.If):
+            cur = cur.orelse[0]
+        else:
+            if cur.orelse:
+                out.append(("<else>", stmt, cur.orelse))
+            break
+    return out if len(out) >= 2 else None
+
+
+def _can_fall_off(stmts: List[ast.stmt]) -> bool:
+    """May control run off the end of this list (exception-free paths)?"""
+    for stmt in stmts:
+        if _always_leaves(stmt):
+            return False
+    return True
+
+
+def _always_leaves(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Return, ast.Raise)):
+        return True
+    if isinstance(stmt, ast.If):
+        return bool(stmt.orelse) and not _can_fall_off(stmt.body) \
+            and not _can_fall_off(stmt.orelse)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return not _can_fall_off(stmt.body)
+    if isinstance(stmt, ast.Try):
+        if stmt.finalbody and not _can_fall_off(stmt.finalbody):
+            return True
+        body_leaves = not _can_fall_off(stmt.body + stmt.orelse)
+        handlers_leave = all(
+            not _can_fall_off(h.body) for h in stmt.handlers
+        ) if stmt.handlers else True
+        return body_leaves and handlers_leave
+    if isinstance(stmt, ast.While):
+        # `while True:` with no break never falls through
+        is_true = isinstance(stmt.test, ast.Constant) and stmt.test.value is True
+        has_break = any(
+            isinstance(n, ast.Break) for n in ast.walk(stmt)
+            if not isinstance(n, _FN_NODES)
+        )
+        return is_true and not has_break
+    return False
+
+
+def _lock_attr_names(tree: ast.Module) -> Set[str]:
+    """Attribute names assigned a lock anywhere in the file — either a
+    direct factory call or a wrapper call one of whose arguments is a
+    factory call (``lockwatch.maybe_wrap(threading.Condition(), ...)``)."""
+    out: Set[str] = set()
+
+    def is_factory(call: ast.AST) -> bool:
+        return isinstance(call, ast.Call) and call_name(call) in LOCK_FACTORIES
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        lockish = is_factory(call) or any(is_factory(a) for a in call.args)
+        if not lockish:
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                out.add(target.attr)
+    return out
+
+
+@register
+class DaemonProtocolChecker(Checker):
+    rule = "DK113"
+    name = "daemon-protocol-discipline"
+    description = (
+        "verb handler/endpoint reply-count discipline and socket I/O while "
+        "holding the daemon's condition variable"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        mod = fi.module or ""
+        if mod != "distkeras_tpu" and not mod.startswith("distkeras_tpu."):
+            return
+        lock_attrs = _lock_attr_names(fi.tree)
+        endpoint_fns = self._endpoint_handlers(fi.tree)
+        for fn in ast.walk(fi.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls = {
+                (call_name(n) or "").rpartition(".")[2]
+                for n in ast.walk(fn) if isinstance(n, ast.Call)
+            }
+            if "recv_data" in calls and "send_data" in calls:
+                yield from self._check_verb_handler(fi, fn)
+            if id(fn) in endpoint_fns:
+                yield from self._check_endpoint(fi, fn)
+            if lock_attrs:
+                yield from self._check_cv_io(fi, fn, lock_attrs)
+
+    # ------------------------------------------------------- verb handlers
+
+    def _check_verb_handler(
+        self, fi: FileInfo, fn: ast.AST
+    ) -> Iterable[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, _FN_NODES) and node is not fn:
+                continue
+            if not isinstance(node, ast.If):
+                continue
+            chain = _verb_chain(node)
+            if chain is None:
+                continue
+            has_else = any(verb == "<else>" for verb, _, _ in chain)
+            for verb, branch, else_body in chain:
+                body = else_body if else_body is not None else branch.body
+                counts = _count_block(body)
+                if not counts.precise:
+                    continue
+                totals = counts.fall | counts.done
+                where = branch if else_body is None else node
+                if 0 in totals and totals != {0}:
+                    # some path replies, another does not — the classic
+                    # missing-else-leg inside a verb
+                    yield self._finding(
+                        fi, where,
+                        f"verb '{verb}' replies on some paths but not "
+                        "others — every exception-free path must send_data "
+                        "exactly once",
+                    )
+                elif totals == {0} and not has_else:
+                    # a reply-free branch is only legal when a shared
+                    # trailing send exists; with no else the chain has no
+                    # shared tail convention — treat as silent verb
+                    yield self._finding(
+                        fi, where,
+                        f"verb '{verb}' never replies — the client blocks "
+                        "in recv_data forever",
+                    )
+                elif any(c >= 2 for c in totals):
+                    yield self._finding(
+                        fi, where,
+                        f"verb '{verb}' can reply more than once on a "
+                        "path — double send_data desynchronises the "
+                        "framing for the next exchange",
+                    )
+            if not has_else:
+                yield self._finding(
+                    fi, node,
+                    "verb dispatch has no else leg: an unknown action "
+                    "falls through without a reply and the client hangs",
+                )
+            break  # one dispatch chain per handler
+
+    # ---------------------------------------------------------- endpoints
+
+    def _endpoint_handlers(self, tree: ast.Module) -> Set[int]:
+        """ids of function defs passed by name to an add_endpoint/route
+        registrar in the same file."""
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        out: Set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = (call_name(node) or "").rpartition(".")[2]
+            if "endpoint" not in cname and "route" not in cname:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    for fn in defs.get(arg.id, []):
+                        out.add(id(fn))
+        return out
+
+    def _check_endpoint(self, fi: FileInfo, fn: ast.AST) -> Iterable[Finding]:
+        if _can_fall_off(fn.body):
+            yield self._finding(
+                fi, fn,
+                f"endpoint handler '{fn.name}' can fall off the end "
+                "without returning a response tuple — the exporter serves "
+                "a bodyless 500",
+            )
+        nested: Set[int] = set()
+        for child in ast.walk(fn):
+            if child is not fn and isinstance(child, _FN_NODES):
+                nested.update(id(s) for s in ast.walk(child))
+        for node in ast.walk(fn):
+            if id(node) in nested:
+                continue
+            if isinstance(node, ast.Return) and node.value is None:
+                yield self._finding(
+                    fi, node,
+                    f"bare return in endpoint handler '{fn.name}' sends no "
+                    "response — return an explicit (content_type, body, "
+                    "status) tuple",
+                )
+
+    # ------------------------------------------------------ cv-held I/O
+
+    def _check_cv_io(
+        self, fi: FileInfo, fn: ast.AST, lock_attrs: Set[str]
+    ) -> Iterable[Finding]:
+        nested: Set[int] = set()
+        for child in ast.walk(fn):
+            if child is not fn and isinstance(child, _FN_NODES):
+                nested.update(id(s) for s in ast.walk(child))
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = [
+                item.context_expr.attr
+                for item in node.items
+                if isinstance(item.context_expr, ast.Attribute)
+                and isinstance(item.context_expr.value, ast.Name)
+                and item.context_expr.value.id == "self"
+                and item.context_expr.attr in lock_attrs
+            ]
+            if not held:
+                continue
+            for sub in ast.walk(node):
+                if id(sub) in nested or not isinstance(sub, ast.Call):
+                    continue
+                last = (call_name(sub) or "").rpartition(".")[2]
+                is_socket = (
+                    last in ("send_data", "recv_data")
+                    or (
+                        isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in SOCKET_METHODS
+                    )
+                )
+                if is_socket:
+                    yield self._finding(
+                        fi, sub,
+                        f"socket I/O while holding self.{held[0]} — a slow "
+                        "peer stalls every thread waiting on the cv; "
+                        "release before touching the network",
+                    )
+
+    def _finding(self, fi: FileInfo, node: ast.AST, why: str) -> Finding:
+        return Finding(
+            path=fi.relpath,
+            line=node.lineno,
+            col=node.col_offset,
+            rule=self.rule,
+            message=f"daemon protocol: {why}",
+        )

@@ -23,9 +23,15 @@ destroying the async-dispatch pipelining the windowed engines depend on.
     module called from a hot engine body is still a per-step sync);
   * every ``def``/``lambda`` nested inside a hot function.
 
-``float``/``int`` casts are only flagged when applied to a *parameter of the
-hot function itself* (a traced value); casts of closure variables from the
-enclosing factory are trace-time constants and stay legal.
+Since v3 every sync candidate is judged by **value provenance** (the
+dataflow layer in :mod:`tools.dklint.dataflow`): a call is only flagged
+when the value it syncs may derive from the hot function's own parameters
+(or ``self``).  Closure variables and globals are trace-time constants —
+``const.item()`` inside a jitted body where ``const`` comes from the
+enclosing factory executes once at trace time, not per step — and a
+parameter name that was **rebound to a host value** before the sync
+(``x = 0.0; float(x)``) no longer refers to the traced argument, which
+kills the reassignment false-positive class v2 needed inline disables for.
 """
 
 from __future__ import annotations
@@ -33,6 +39,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from tools.dklint import dataflow
 from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name, dotted_name
 from tools.dklint.registry import register
 
@@ -276,17 +283,13 @@ def _modules_match(target_mod: str, analyzed_mod: str) -> bool:
     )
 
 
-def global_hot_functions(project: Project) -> Set[int]:
-    """ids of hot function nodes across every analyzed file, with hotness
-    propagated through cross-module calls (memoized per run)."""
-    cached = project.data.get(HOT_KEY)
-    if cached is not None:
-        return cached
+def propagate_hot(project: Project, seeds: Set[int]) -> Set[int]:
+    """Close a seed set of function-node ids over local/self calls,
+    cross-module calls (via each file's import map), and nesting — the
+    same fixpoint DK101 hotness uses, reusable with different seeds
+    (DK112 adds the serving decode loop)."""
     all_facts: Dict[str, dict] = project.data.get(FACTS_KEY, {})
-
-    hot: Set[int] = set()
-    for facts in all_facts.values():
-        hot |= _seed_hot(facts)
+    hot = set(seeds)
 
     # module-level named defs across the tree, for cross-module resolution
     toplevel: Dict[str, List[Tuple[str, ast.AST]]] = {}
@@ -330,7 +333,20 @@ def global_hot_functions(project: Project) -> Set[int]:
                 if parent in hot and id(fn) not in hot:
                     hot.add(id(fn))
                     changed = True
+    return hot
 
+
+def global_hot_functions(project: Project) -> Set[int]:
+    """ids of hot function nodes across every analyzed file, with hotness
+    propagated through cross-module calls (memoized per run)."""
+    cached = project.data.get(HOT_KEY)
+    if cached is not None:
+        return cached
+    all_facts: Dict[str, dict] = project.data.get(FACTS_KEY, {})
+    seeds: Set[int] = set()
+    for facts in all_facts.values():
+        seeds |= _seed_hot(facts)
+    hot = propagate_hot(project, seeds)
     project.data[HOT_KEY] = hot
     return hot
 
@@ -381,12 +397,30 @@ class HostSyncChecker(Checker):
             ):
                 for sub in ast.walk(child):
                     nested.add(id(sub))
+        # value provenance, built lazily: only values that may derive from
+        # this function's own parameters (or self) are traced at runtime —
+        # closure constants and host-rebound names sync at trace time once,
+        # which is legal
+        tainted: Optional[Set[int]] = None
+
+        def _tainted() -> Set[int]:
+            nonlocal tainted
+            if tainted is None:
+                flow = dataflow.function_flow(fn)
+                tainted = dataflow.tainted_uses(flow, params | {"self", "cls"})
+            return tainted
+
+        def _derives_from_inputs(expr: ast.AST) -> bool:
+            t = _tainted()
+            return any(id(u) in t for u in dataflow.expr_uses(expr))
+
         for node in ast.walk(fn):
             if id(node) in nested or not isinstance(node, ast.Call):
                 continue
             cname = call_name(node)
             if cname in HOST_SYNC_CALLS:
-                yield self._finding(fi, node, HOST_SYNC_CALLS[cname])
+                if any(_derives_from_inputs(a) for a in node.args):
+                    yield self._finding(fi, node, HOST_SYNC_CALLS[cname])
                 continue
             if (
                 isinstance(node.func, ast.Attribute)
@@ -394,14 +428,15 @@ class HostSyncChecker(Checker):
                 and not node.args
             ):
                 # jax.block_until_ready(x) handled above; x.item() here
-                yield self._finding(fi, node, HOST_SYNC_METHODS[node.func.attr])
+                if _derives_from_inputs(node.func.value):
+                    yield self._finding(fi, node, HOST_SYNC_METHODS[node.func.attr])
                 continue
             if cname in ("float", "int") and len(node.args) == 1:
                 arg = node.args[0]
-                # flag only casts of this function's own (traced) parameters;
-                # closure variables from the enclosing factory are trace-time
-                # constants (e.g. float(window) in a window-body closure)
-                if isinstance(arg, ast.Name) and arg.id in params:
+                # flag only casts of values that still refer to a traced
+                # argument at this use — a parameter rebound to a host value
+                # (``x = 0.0``) and closure/factory constants stay legal
+                if isinstance(arg, ast.Name) and id(arg) in _tainted():
                     yield self._finding(
                         fi, node,
                         f"{cname}() on traced argument '{arg.id}' forces a "

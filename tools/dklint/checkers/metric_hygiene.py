@@ -1,0 +1,255 @@
+"""DK114 — metric-name hygiene against the golden exported set.
+
+Metric names are an API: dashboards, the fleet aggregator, and the golden
+scrape files under ``tests/golden/*_metrics.txt`` all key on the exact
+string.  A typo'd registration (``serving_token_latency_secs``) silently
+creates a *second* time series next to the real one — no error, just a
+dashboard that flatlines after the next deploy.  This rule cross-checks
+every ``registry.counter/gauge/histogram("name", ...)`` literal in the
+package against every other registration and against the golden exports:
+
+  * the same name registered as two different metric kinds, or with two
+    different help strings (the exporters keep whichever came first);
+  * a registered kind conflicting with the ``# TYPE`` line the goldens
+    pin for that name;
+  * a near-miss — edit distance 1-2 from a golden or registered name of
+    comparable length — which is a typo until proven otherwise;
+  * golden files that disagree with each other on a metric's label keys
+    (the fleet merge joins on the full label set).
+
+F-string / computed names are skipped (``sanitizer_{kind}_violations`` is
+a family, not a literal).  Scope: ``distkeras_tpu`` modules.  Static-only:
+no runtime twin — a duplicate time series is valid Prometheus text.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+from tools.dklint.dataflow import edit_distance
+
+REG_KEY = "DK114.registrations"
+GOLDEN_KEY = "DK114.golden"
+
+METRIC_KINDS = frozenset({"counter", "gauge", "histogram"})
+
+# shorter names produce too many legitimate 1-2 edit neighbours
+_NEAR_MISS_MIN_LEN = 10
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)\{([^}]*)\}\s")
+_LABEL_KEY_RE = re.compile(r"([a-zA-Z_][a-zA-Z0-9_]*)=")
+
+# prometheus sample suffixes that belong to the base histogram name
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+class _Registration:
+    __slots__ = ("name", "kind", "help", "path", "line", "col")
+
+    def __init__(self, name: str, kind: str, help: str, path: str,
+                 line: int, col: int):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.path = path
+        self.line = line
+        self.col = col
+
+
+def _help_arg(node: ast.Call) -> Optional[str]:
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        return node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "help" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _file_registrations(fi: FileInfo) -> List[_Registration]:
+    out: List[_Registration] = []
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        kind = node.func.attr
+        if kind not in METRIC_KINDS:
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant):
+            continue  # f-string / computed families are out of scope
+        name = node.args[0].value
+        if not isinstance(name, str):
+            continue
+        out.append(_Registration(
+            name, kind, _help_arg(node) or "", fi.relpath,
+            node.lineno, node.col_offset,
+        ))
+    return out
+
+
+def _strip_hist_suffix(name: str) -> str:
+    for sfx in _HIST_SUFFIXES:
+        if name.endswith(sfx):
+            return name[: -len(sfx)]
+    return name
+
+
+def _load_golden(root: str) -> Dict[str, dict]:
+    """name -> {"kind", "files", "labels": {file: frozenset(keys)}} parsed
+    from every tests/golden/*_metrics.txt."""
+    out: Dict[str, dict] = {}
+    pattern = os.path.join(root, "tests", "golden", "*_metrics.txt")
+    for path in sorted(glob.glob(pattern)):
+        fname = os.path.basename(path)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            continue
+        kinds: Dict[str, str] = {}
+        for line in lines:
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                if len(parts) >= 4:
+                    name, kind = parts[2], parts[3]
+                    kinds[name] = kind
+                    entry = out.setdefault(
+                        name, {"kind": kind, "files": set(), "labels": {}}
+                    )
+                    entry["files"].add(fname)
+            elif line and not line.startswith("#"):
+                m = _SAMPLE_RE.match(line)
+                if not m:
+                    continue
+                raw, label_blob = m.group(1), m.group(2)
+                base = _strip_hist_suffix(raw)
+                if base not in out:
+                    continue
+                keys = frozenset(
+                    k for k in _LABEL_KEY_RE.findall(label_blob) if k != "le"
+                )
+                out[base]["labels"].setdefault(fname, set()).update(keys)
+    return out
+
+
+def _golden(project: Project) -> Dict[str, dict]:
+    cached = project.data.get(GOLDEN_KEY)
+    if cached is None:
+        cached = project.data[GOLDEN_KEY] = _load_golden(project.root)
+    return cached
+
+
+@register
+class MetricHygieneChecker(Checker):
+    rule = "DK114"
+    name = "metric-name-hygiene"
+    description = (
+        "duplicate/near-miss metric name literals and kind/label drift vs "
+        "the golden exported set"
+    )
+
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        mod = fi.module or ""
+        if mod != "distkeras_tpu" and not mod.startswith("distkeras_tpu."):
+            return
+        regs = _file_registrations(fi)
+        if regs:
+            project.data.setdefault(REG_KEY, []).extend(regs)
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        mod = fi.module or ""
+        if mod != "distkeras_tpu" and not mod.startswith("distkeras_tpu."):
+            return
+        all_regs: List[_Registration] = project.data.get(REG_KEY, [])
+        golden = _golden(project)
+        mine = [r for r in all_regs if r.path == fi.relpath]
+        for reg in mine:
+            yield from self._check_registration(fi, reg, all_regs, golden)
+
+    def _check_registration(
+        self,
+        fi: FileInfo,
+        reg: _Registration,
+        all_regs: List[_Registration],
+        golden: Dict[str, dict],
+    ) -> Iterable[Finding]:
+        # conflicting re-registration anywhere in the package: the
+        # registry returns the first instrument, so the second kind/help
+        # silently loses
+        for other in all_regs:
+            if other is reg or other.name != reg.name:
+                continue
+            earlier = (other.path, other.line) < (reg.path, reg.line)
+            if not earlier:
+                continue
+            if other.kind != reg.kind:
+                yield self._finding(
+                    fi, reg,
+                    f"metric '{reg.name}' registered as {reg.kind} here but "
+                    f"as {other.kind} at {other.path}:{other.line} — the "
+                    "registry keeps the first, this instrument is a no-op",
+                )
+            elif other.help != reg.help:
+                yield self._finding(
+                    fi, reg,
+                    f"metric '{reg.name}' re-registered with a different "
+                    f"help string than {other.path}:{other.line} — scrapes "
+                    "show whichever came first",
+                )
+        entry = golden.get(reg.name)
+        if entry is not None and entry["kind"] != reg.kind:
+            yield self._finding(
+                fi, reg,
+                f"metric '{reg.name}' registered as {reg.kind} but the "
+                f"golden exports pin it as {entry['kind']} "
+                f"({'/'.join(sorted(entry['files']))})",
+            )
+        if entry is not None:
+            label_sets = {
+                f: frozenset(keys) for f, keys in entry["labels"].items()
+            }
+            if len(set(label_sets.values())) > 1:
+                detail = ", ".join(
+                    f"{f}={{{','.join(sorted(k))}}}"
+                    for f, k in sorted(label_sets.items())
+                )
+                yield self._finding(
+                    fi, reg,
+                    f"golden files disagree on label keys for "
+                    f"'{reg.name}' ({detail}) — the fleet merge joins on "
+                    "the full label set",
+                )
+        # a name the goldens already export is ground truth — only names
+        # *near* the known set are typo suspects
+        if reg.name not in golden and len(reg.name) >= _NEAR_MISS_MIN_LEN:
+            neighbours: Set[str] = set(golden)
+            neighbours.update(r.name for r in all_regs)
+            neighbours.discard(reg.name)
+            for near in sorted(neighbours):
+                if len(near) < _NEAR_MISS_MIN_LEN:
+                    continue
+                if edit_distance(reg.name, near, cap=3) <= 2:
+                    yield self._finding(
+                        fi, reg,
+                        f"metric name '{reg.name}' is an edit away from "
+                        f"existing '{near}' — a typo creates a second "
+                        "time series dashboards never see",
+                    )
+                    break
+
+    def _finding(self, fi: FileInfo, reg: _Registration, why: str) -> Finding:
+        return Finding(
+            path=fi.relpath,
+            line=reg.line,
+            col=reg.col,
+            rule=self.rule,
+            message=f"metric hygiene: {why}",
+        )

@@ -1,0 +1,194 @@
+"""DK111 — PRNG key lineage: one key value consumed by two random ops.
+
+``jax.random`` keys are single-use: every consuming op (``split``,
+``uniform``, ``categorical``, ...) must get a key value no other op sees,
+or the two draws are bit-correlated — with threefry, ``split(key)`` and
+``split(key, n)`` even share a literal prefix, so "independent" streams
+derived from the same parent key can be *identical*.  That is exactly the
+bug this rule was built to flag at ``serving/sampling.py:131-132``: the
+speculative path re-split the same ``key`` the plain path had split,
+making the first accept-uniform reuse the plain sampling key.
+
+Dataflow-powered: a *key value* is a reaching definition (parameter,
+assignment, loop target).  The rule fires when
+
+  * one definition reaches the key argument of **two** ``jax.random``
+    consuming calls that can both execute in one run of the function
+    (CFG-reachable, so exclusive ``if``/``else`` arms stay legal), or
+  * the single consuming call sits inside a loop while every reaching
+    definition of its key is **outside** the loop — the same value is
+    consumed once per iteration.
+
+``fold_in`` is exempt on both counts: deriving per-step keys via
+``fold_in(key, i)`` is the sanctioned streaming idiom, and it coexists
+with one ``split`` of the same parent.  Key *constructors*
+(``PRNGKey``/``key``) are producers, not consumers.  Scope: modules under
+``distkeras_tpu`` — tests and fixtures reuse keys on purpose.
+
+Runtime twin: none (static-only) — correlated streams produce no error,
+only silently degraded randomness, which is precisely why the lint exists.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.dklint import dataflow
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+
+# jax.random callables whose first positional argument is a consumed key
+CONSUMERS = frozenset({
+    "jax.random.split",
+    "jax.random.fold_in",
+    "jax.random.uniform",
+    "jax.random.normal",
+    "jax.random.bernoulli",
+    "jax.random.categorical",
+    "jax.random.gumbel",
+    "jax.random.randint",
+    "jax.random.truncated_normal",
+    "jax.random.permutation",
+    "jax.random.choice",
+    "jax.random.exponential",
+    "jax.random.laplace",
+    "jax.random.gamma",
+    "jax.random.beta",
+    "jax.random.dirichlet",
+    "jax.random.poisson",
+    "jax.random.shuffle",
+    "jax.random.multivariate_normal",
+})
+
+
+def _resolved_call(node: ast.Call, fi: FileInfo) -> Optional[str]:
+    """Dotted call target with the leading segment resolved through the
+    file's import map (``jrandom.split`` -> ``jax.random.split``)."""
+    name = call_name(node) or ""
+    head, _, rest = name.partition(".")
+    resolved = fi.imports.get(head)
+    if resolved:
+        name = resolved + ("." + rest if rest else "")
+    return name or None
+
+
+def _consumption_sites(
+    fn: ast.AST, fi: FileInfo
+) -> List[Tuple[ast.Call, ast.Name, bool]]:
+    """(call, key Name arg, is_fold_in) for jax.random consumers in ``fn``,
+    excluding nested function bodies (their own flow is analyzed
+    separately) and calls whose key argument is not a plain name (a
+    ``split(PRNGKey(seed))`` chain consumes a throwaway value)."""
+    nested: Set[int] = set()
+    for child in ast.walk(fn):
+        if child is not fn and isinstance(
+            child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            nested.update(id(s) for s in ast.walk(child))
+    sites: List[Tuple[ast.Call, ast.Name, bool]] = []
+    for node in ast.walk(fn):
+        if id(node) in nested or not isinstance(node, ast.Call):
+            continue
+        cname = _resolved_call(node, fi)
+        if cname not in CONSUMERS:
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            continue
+        # vmap(jax.random.split)(keys): the outer call's func is a Call,
+        # never a CONSUMERS name, so it is skipped naturally
+        sites.append((node, node.args[0], cname.endswith(".fold_in")))
+    sites.sort(key=lambda s: (s[0].lineno, s[0].col_offset))
+    return sites
+
+
+@register
+class PrngLineageChecker(Checker):
+    rule = "DK111"
+    name = "prng-key-reuse"
+    description = (
+        "one PRNG key value consumed by two jax.random ops (or re-consumed "
+        "across loop iterations) without a re-split — correlated streams"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        mod = fi.module or ""
+        if mod != "distkeras_tpu" and not mod.startswith("distkeras_tpu."):
+            return
+        for fn in ast.walk(fi.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            sites = _consumption_sites(fn, fi)
+            if not sites:
+                continue
+            yield from self._check_fn(fi, fn, sites)
+
+    def _check_fn(
+        self,
+        fi: FileInfo,
+        fn: ast.AST,
+        sites: List[Tuple[ast.Call, ast.Name, bool]],
+    ) -> Iterable[Finding]:
+        flow = dataflow.function_flow(fn)
+
+        # group consumption sites by the definition(s) of their key value
+        by_def: Dict[int, List[Tuple[ast.Call, ast.Name, bool]]] = {}
+        defs_by_id: Dict[int, dataflow.Def] = {}
+        for call, key, fold in sites:
+            for d in flow.reaching(key):
+                defs_by_id[id(d)] = d
+                by_def.setdefault(id(d), []).append((call, key, fold))
+
+        flagged: Set[int] = set()
+        for did, consumers in by_def.items():
+            live = [(c, k) for c, k, fold in consumers if not fold]
+            # pairwise: two consumers of one value that may both execute
+            for i in range(len(live)):
+                for j in range(i + 1, len(live)):
+                    first_call, first_key = live[i]
+                    call, key = live[j]
+                    if id(call) in flagged:
+                        continue
+                    if not flow.may_follow(first_key, key):
+                        continue  # exclusive branches — one run sees one
+                    flagged.add(id(call))
+                    yield Finding(
+                        path=fi.relpath,
+                        line=call.lineno,
+                        col=call.col_offset,
+                        rule=self.rule,
+                        message=(
+                            f"PRNG key '{key.id}' already consumed by the "
+                            f"jax.random call on line {first_call.lineno} — "
+                            "re-splitting/re-using one key value correlates "
+                            "the streams; derive this call's key from a "
+                            "fresh subkey"
+                        ),
+                    )
+            # loop reuse: one consumer, every definition outside its loop
+            if len(live) == 1:
+                call, key = live[0]
+                if id(call) in flagged:
+                    continue
+                loops = flow.enclosing_loops(call)
+                if not loops:
+                    continue
+                innermost = loops[-1]
+                reaching = flow.reaching(key)
+                if reaching and all(
+                    innermost not in flow.enclosing_loops(d.stmt)
+                    for d in reaching
+                ):
+                    flagged.add(id(call))
+                    yield Finding(
+                        path=fi.relpath,
+                        line=call.lineno,
+                        col=call.col_offset,
+                        rule=self.rule,
+                        message=(
+                            f"PRNG key '{key.id}' is consumed inside a loop "
+                            "but never advanced there — every iteration "
+                            "draws from the same key value; split or "
+                            "fold_in per iteration"
+                        ),
+                    )

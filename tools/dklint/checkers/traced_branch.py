@@ -16,13 +16,22 @@ Exemptions (all trace-time static, hence legal Python control flow):
   * ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` and ``len(x)``;
   * parameters named in ``static_argnums``/``static_argnames`` at the
     ``jax.jit`` call site.
+
+v3 judges the branch test by **value provenance** (the dataflow layer):
+the test is only flagged when the name it bools may still refer to a
+traced-parameter-derived value at that program point.  ``x = 0; if x:``
+after rebinding ``x`` to a host constant is legal Python control flow —
+the reassignment false-positive class v2 could not see past — while
+``x = x * 2; if x:`` stays flagged because the rebound value still
+derives from the traced input.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional, Set
+from typing import Callable, Iterable, List, Optional, Set
 
+from tools.dklint import dataflow
 from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
 from tools.dklint.registry import register
 from tools.dklint.checkers.host_sync import TRACING_WRAPPERS
@@ -50,17 +59,18 @@ def _static_at_callsite(call: ast.Call, fn: ast.AST) -> Set[str]:
     return static
 
 
-def _traced_uses(test: ast.AST, params: Set[str]) -> List[ast.Name]:
+def _traced_uses(test: ast.AST, is_traced: Callable[[ast.Name], bool]) -> List[ast.Name]:
     """Name nodes in a test expression that force bool() on a traced value.
 
     Walks manually so statically-evaluable forms (``is None``,
     ``isinstance``, ``.shape``-family attributes, ``len()``) skip their
-    traced operand instead of flagging it."""
+    traced operand instead of flagging it.  ``is_traced`` judges each
+    candidate ``Name`` (v3: by dataflow provenance, not raw spelling)."""
     out: List[ast.Name] = []
 
     def visit(node: ast.AST) -> None:
         if isinstance(node, ast.Name):
-            if node.id in params:
+            if is_traced(node):
                 out.append(node)
             return
         if isinstance(node, ast.Attribute):
@@ -145,6 +155,10 @@ class TracedBranchChecker(Checker):
             for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
             if a.arg not in ("self", "cls")
         } - static
+        # provenance: a use is traced when any reaching definition derives
+        # from a (non-static) parameter — rebinding to a host value clears it
+        flow = dataflow.function_flow(fn)
+        tainted = dataflow.tainted_uses(flow, params)
         nested: Set[int] = set()
         for child in ast.walk(fn):
             if child is not fn and isinstance(
@@ -157,7 +171,7 @@ class TracedBranchChecker(Checker):
             if not isinstance(node, (ast.If, ast.While)):
                 continue
             kind = "if" if isinstance(node, ast.If) else "while"
-            for use in _traced_uses(node.test, params):
+            for use in _traced_uses(node.test, lambda n: id(n) in tainted):
                 yield Finding(
                     path=fi.relpath,
                     line=node.lineno,

@@ -9,13 +9,88 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Set
 
 from tools.dklint import core
 from tools.dklint.registry import all_rules
 
 DEFAULT_BASELINE = os.path.join("tools", "dklint", "baseline.json")
+
+
+def changed_files(root: str, ref: str) -> Set[str]:
+    """Root-relative (forward-slash) paths changed vs. ``ref``, plus
+    untracked files — the PR-diff set ``--since`` filters findings to."""
+    out: Set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", ref, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, cwd=root, capture_output=True, text=True, timeout=60,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"`{' '.join(cmd)}` failed: {proc.stderr.strip() or 'unknown error'}"
+            )
+        out.update(
+            line.strip().replace(os.sep, "/")
+            for line in proc.stdout.splitlines()
+            if line.strip()
+        )
+    return out
+
+
+_SARIF_LEVEL = "warning"
+
+
+def to_sarif(findings: Sequence[core.Finding]) -> dict:
+    """SARIF 2.1.0 log for the given findings (every registered rule is
+    described in the driver so rule metadata survives an empty run)."""
+    rules = [
+        {
+            "id": rule,
+            "name": cls.name,
+            "shortDescription": {"text": cls.description},
+        }
+        for rule, cls in sorted(all_rules().items())
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL,
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "dklint",
+                        "informationUri": "tools/dklint",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -40,8 +115,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prune-baseline", action="store_true",
                    help="drop baseline entries that no longer match any "
                         "finding (keeps reasons on the survivors) and exit 0")
-    p.add_argument("--format", choices=("text", "json", "github"), default="text",
-                   help="github emits ::warning workflow annotations")
+    p.add_argument("--since", default=None, metavar="GIT_REF",
+                   help="report findings only for files changed vs. this git "
+                        "ref (the whole tree is still analyzed, so "
+                        "cross-module facts stay correct)")
+    p.add_argument("--format", choices=("text", "json", "github", "sarif"),
+                   default="text",
+                   help="github emits ::warning workflow annotations; sarif "
+                        "emits a SARIF 2.1.0 log for code-scanning upload")
     p.add_argument("--list-rules", action="store_true",
                    help="list registered rules and exit")
     return p
@@ -92,6 +173,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         entries = core.load_baseline(baseline_path)
         findings, stale = core.apply_baseline(findings, entries, files)
 
+    if args.since:
+        try:
+            changed = changed_files(root, args.since)
+        except (RuntimeError, OSError, subprocess.SubprocessError) as e:
+            print(f"dklint: --since: {e}", file=sys.stderr)
+            return 2
+        findings = [f for f in findings if f.path in changed]
+        stale = [e for e in stale if e.get("path") in changed]
+
     if args.format == "json":
         print(json.dumps([f.__dict__ for f in findings], indent=2))
     elif args.format == "github":
@@ -104,6 +194,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"::warning file={f.path},line={f.line},col={f.col + 1},"
                 f"title=dklint {f.rule}::{message}"
             )
+        if findings:
+            print(f"dklint: {len(findings)} unbaselined finding(s)", file=sys.stderr)
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(findings), indent=2))
         if findings:
             print(f"dklint: {len(findings)} unbaselined finding(s)", file=sys.stderr)
     else:

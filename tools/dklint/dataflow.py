@@ -1,0 +1,524 @@
+"""Intraprocedural dataflow for dklint v3: CFG + reaching definitions +
+value provenance.
+
+Checkers ask two questions the flat AST walks of v1/v2 could not answer:
+
+  * **which definition does this name refer to here?** —
+    :meth:`FunctionFlow.reaching` maps every ``Name`` load to the set of
+    definitions (assignments, loop targets, parameters, ...) that may have
+    produced the value it reads, computed over a per-function control-flow
+    graph with a standard reaching-definitions fixpoint;
+  * **may this value derive from a traced input?** — :func:`tainted_uses`
+    closes provenance over assignments (``y = x * 2`` taints ``y`` when
+    ``x`` is tainted), which is what lets DK101/DK109 stop flagging a
+    parameter name after it was rebound to a host constant, and keep
+    flagging it when the rebinding still derives from the parameter.
+
+The CFG is statement-granular: one node per simple statement, plus head
+nodes for ``if``/``while`` tests and ``for`` iterators.  ``try`` bodies are
+modelled conservatively — every node of the body may transfer to every
+handler (an exception can fire mid-statement), so a handler's entry state is
+the union of all states the body can be in.  Nested ``def``/``lambda``
+bodies are opaque (each function gets its own :class:`FunctionFlow`); the
+``def`` statement itself is a binding of the function name.
+
+Everything is stdlib ``ast`` — no execution, no imports of analyzed code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Def", "FunctionFlow", "function_flow", "tainted_uses",
+           "expr_uses", "edit_distance"]
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class Def:
+    """One definition of a local name.
+
+    ``kind`` is one of ``param`` / ``assign`` / ``aug`` / ``for`` /
+    ``with`` / ``except`` / ``bind`` (def/class/import) / ``walrus``.
+    ``value`` is the expression the bound value comes from when there is
+    one (the RHS, the ``for`` iterator, the ``with`` context expression);
+    ``use_nodes`` are the ``Name`` loads inside that expression, i.e. the
+    dataflow inputs of this definition.
+    """
+
+    __slots__ = ("name", "stmt", "value", "kind", "use_nodes")
+
+    def __init__(self, name: str, stmt: ast.AST, value: Optional[ast.AST],
+                 kind: str, use_nodes: Optional[List[ast.Name]] = None):
+        self.name = name
+        self.stmt = stmt
+        self.value = value
+        self.kind = kind
+        self.use_nodes = use_nodes if use_nodes is not None else (
+            _expr_uses(value) if value is not None else [])
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        line = getattr(self.stmt, "lineno", "?")
+        return f"<Def {self.name} {self.kind}@{line}>"
+
+
+def _expr_uses(node: Optional[ast.AST]) -> List[ast.Name]:
+    """``Name`` loads evaluated by an expression, in source order.  Skips
+    nested function/lambda bodies (deferred execution) but not
+    comprehensions (they run immediately and close over outer names)."""
+    out: List[ast.Name] = []
+    if node is None:
+        return out
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, _FN_NODES) and cur is not node:
+            # default values / decorators of a nested def are evaluated in
+            # the enclosing scope; its body is not
+            if isinstance(cur, ast.Lambda):
+                stack.extend(ast.iter_child_nodes(cur.args))
+            continue
+        if isinstance(cur, ast.Name) and isinstance(cur.ctx, ast.Load):
+            out.append(cur)
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out
+
+
+def expr_uses(node: Optional[ast.AST]) -> List[ast.Name]:
+    """Public alias of :func:`_expr_uses` for checkers that need the
+    ``Name`` loads of an arbitrary expression to intersect with a taint
+    set."""
+    return _expr_uses(node)
+
+
+def _target_names(target: ast.AST) -> List[ast.Name]:
+    """Plain-``Name`` binding targets of an assignment target (tuples and
+    starred elements unpacked; attribute/subscript stores are not local
+    defs)."""
+    if isinstance(target, ast.Name):
+        return [target]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[ast.Name] = []
+        for el in target.elts:
+            out.extend(_target_names(el))
+        return out
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    return []
+
+
+class _Node:
+    __slots__ = ("stmt", "uses", "gen", "kills", "succ", "preds", "in_defs")
+
+    def __init__(self, stmt: Optional[ast.AST]):
+        self.stmt = stmt
+        self.uses: List[ast.Name] = []
+        self.gen: List[Def] = []
+        self.kills: Set[str] = set()  # del-statement kills with no new def
+        self.succ: List["_Node"] = []
+        self.preds: List["_Node"] = []
+        self.in_defs: Dict[str, frozenset] = {}
+
+
+def _walrus_defs(stmt: ast.AST) -> List[Def]:
+    """``(y := f(x))`` bindings anywhere in a statement's expressions."""
+    out: List[Def] = []
+    stack: List[ast.AST] = list(ast.iter_child_nodes(stmt))
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, _FN_NODES):
+            continue
+        if isinstance(cur, ast.NamedExpr) and isinstance(cur.target, ast.Name):
+            out.append(Def(cur.target.id, stmt, cur.value, "walrus"))
+        stack.extend(ast.iter_child_nodes(cur))
+    return out
+
+
+class _CFGBuilder:
+    def __init__(self) -> None:
+        self.nodes: List[_Node] = []
+        self.exit = self._node(None)
+        # stack of (break_frontier, continue_target) per enclosing loop
+        self._loops: List[Tuple[List[_Node], _Node]] = []
+
+    def _node(self, stmt: Optional[ast.AST]) -> _Node:
+        n = _Node(stmt)
+        self.nodes.append(n)
+        return n
+
+    @staticmethod
+    def _connect(preds: Sequence[_Node], node: _Node) -> None:
+        for p in preds:
+            node_succ = p.succ
+            if node not in node_succ:
+                node_succ.append(node)
+
+    def block(self, stmts: Sequence[ast.stmt], preds: List[_Node]) -> List[_Node]:
+        for stmt in stmts:
+            preds = self.stmt(stmt, preds)
+        return preds
+
+    def stmt(self, stmt: ast.stmt, preds: List[_Node]) -> List[_Node]:
+        if isinstance(stmt, ast.If):
+            test = self._node(stmt)
+            test.uses = _expr_uses(stmt.test)
+            test.gen = _walrus_defs(stmt)
+            self._connect(preds, test)
+            body_out = self.block(stmt.body, [test])
+            else_out = self.block(stmt.orelse, [test]) if stmt.orelse else [test]
+            return body_out + else_out
+
+        if isinstance(stmt, ast.While):
+            test = self._node(stmt)
+            test.uses = _expr_uses(stmt.test)
+            test.gen = _walrus_defs(stmt)
+            self._connect(preds, test)
+            breaks: List[_Node] = []
+            self._loops.append((breaks, test))
+            body_out = self.block(stmt.body, [test])
+            self._connect(body_out, test)  # back edge
+            self._loops.pop()
+            out = self.block(stmt.orelse, [test]) if stmt.orelse else [test]
+            return out + breaks
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            head = self._node(stmt)
+            head.uses = _expr_uses(stmt.iter)
+            head.gen = [
+                Def(t.id, stmt, stmt.iter, "for") for t in _target_names(stmt.target)
+            ] + _walrus_defs(stmt)
+            self._connect(preds, head)
+            breaks = []
+            self._loops.append((breaks, head))
+            body_out = self.block(stmt.body, [head])
+            self._connect(body_out, head)  # back edge
+            self._loops.pop()
+            out = self.block(stmt.orelse, [head]) if stmt.orelse else [head]
+            return out + breaks
+
+        if isinstance(stmt, ast.Try):
+            start = len(self.nodes)
+            body_out = self.block(stmt.body, preds)
+            body_nodes = self.nodes[start:]
+            handler_outs: List[_Node] = []
+            for handler in stmt.handlers:
+                hnode = self._node(handler)
+                hnode.uses = _expr_uses(handler.type)
+                if handler.name:
+                    hnode.gen = [Def(handler.name, handler, None, "except")]
+                # an exception may fire before, or mid-way through, any
+                # statement of the body: the handler can observe every
+                # state the body passes through
+                self._connect(list(preds) + body_nodes, hnode)
+                handler_outs.extend(self.block(handler.body, [hnode]))
+            merged = (
+                self.block(stmt.orelse, body_out) if stmt.orelse else body_out
+            ) + handler_outs
+            if stmt.finalbody:
+                return self.block(stmt.finalbody, merged)
+            return merged
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = self._node(stmt)
+            for item in stmt.items:
+                head.uses.extend(_expr_uses(item.context_expr))
+                if item.optional_vars is not None:
+                    head.gen.extend(
+                        Def(t.id, stmt, item.context_expr, "with")
+                        for t in _target_names(item.optional_vars)
+                    )
+            head.gen.extend(_walrus_defs(stmt))
+            self._connect(preds, head)
+            return self.block(stmt.body, [head])
+
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            n = self._node(stmt)
+            n.uses = _expr_uses(stmt)
+            self._connect(preds, n)
+            self._connect([n], self.exit)
+            return []
+
+        if isinstance(stmt, ast.Break):
+            n = self._node(stmt)
+            self._connect(preds, n)
+            if self._loops:
+                self._loops[-1][0].append(n)
+            else:  # malformed input; keep the graph connected
+                self._connect([n], self.exit)
+            return []
+
+        if isinstance(stmt, ast.Continue):
+            n = self._node(stmt)
+            self._connect(preds, n)
+            if self._loops:
+                self._connect([n], self.loops_head())
+            else:
+                self._connect([n], self.exit)
+            return []
+
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            n = self._node(stmt)
+            # decorators and parameter defaults run now, in this scope;
+            # the body does not
+            for dec in stmt.decorator_list:
+                n.uses.extend(_expr_uses(dec))
+            args = getattr(stmt, "args", None)
+            if args is not None:
+                for default in list(args.defaults) + [
+                    d for d in args.kw_defaults if d is not None
+                ]:
+                    n.uses.extend(_expr_uses(default))
+            if isinstance(stmt, ast.ClassDef):
+                for base in stmt.bases:
+                    n.uses.extend(_expr_uses(base))
+            n.gen = [Def(stmt.name, stmt, None, "bind")]
+            self._connect(preds, n)
+            return [n]
+
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            n = self._node(stmt)
+            for alias in stmt.names:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name.split(".")[0]
+                n.gen.append(Def(bound, stmt, None, "bind"))
+            self._connect(preds, n)
+            return [n]
+
+        # simple statements: Assign / AugAssign / AnnAssign / Expr /
+        # Assert / Delete / Pass / Global / Nonlocal / unknown compounds
+        n = self._node(stmt)
+        if isinstance(stmt, ast.Assign):
+            n.uses = _expr_uses(stmt.value)
+            unpack = any(not isinstance(t, ast.Name) for t in stmt.targets)
+            for target in stmt.targets:
+                n.uses.extend(
+                    u for u in _expr_uses(target)  # a[i] = v evaluates a, i
+                )
+                n.gen.extend(
+                    Def(t.id, stmt, stmt.value,
+                        "assign" if not unpack else "assign")
+                    for t in _target_names(target)
+                )
+        elif isinstance(stmt, ast.AugAssign):
+            n.uses = _expr_uses(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                # the target is read before it is written
+                read = ast.Name(id=stmt.target.id, ctx=ast.Load())
+                ast.copy_location(read, stmt.target)
+                n.uses.append(read)
+                n.gen = [Def(stmt.target.id, stmt, None, "aug",
+                             use_nodes=list(n.uses))]
+            else:
+                n.uses.extend(_expr_uses(stmt.target))
+        elif isinstance(stmt, ast.AnnAssign):
+            n.uses = _expr_uses(stmt.value)
+            if stmt.value is not None and isinstance(stmt.target, ast.Name):
+                n.gen = [Def(stmt.target.id, stmt, stmt.value, "assign")]
+        elif isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    n.kills.add(target.id)
+                else:
+                    n.uses.extend(_expr_uses(target))
+        else:
+            n.uses = _expr_uses(stmt)
+        n.gen = list(n.gen) + _walrus_defs(stmt)
+        self._connect(preds, n)
+        return [n]
+
+    def loops_head(self) -> _Node:
+        return self._loops[-1][1]
+
+
+class FunctionFlow:
+    """CFG + reaching definitions for one function (or lambda)."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.param_defs: Dict[str, Def] = {}
+        args = fn.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        for name in names:
+            self.param_defs[name] = Def(name, fn, None, "param")
+
+        builder = _CFGBuilder()
+        self._entry = builder._node(None)
+        self._entry.gen = list(self.param_defs.values())
+        body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+        if not isinstance(fn.body, list):  # Lambda: body is an expression
+            ast.copy_location(body[0], fn.body)
+        out = builder.block(body, [self._entry])
+        builder._connect(out, builder.exit)
+        self._nodes = builder.nodes
+        for node in self._nodes:
+            for s in node.succ:
+                s.preds.append(node)
+
+        self.defs: List[Def] = [d for n in self._nodes for d in n.gen]
+        self._solve()
+        self._use_defs: Dict[int, Tuple[Def, ...]] = {}
+        self._use_nodes: Dict[int, ast.Name] = {}
+        self._use_owner: Dict[int, _Node] = {}
+        for node in self._nodes:
+            env = node.in_defs
+            for use in node.uses:
+                self._use_defs[id(use)] = tuple(env.get(use.id, ()))
+                self._use_nodes[id(use)] = use
+                self._use_owner[id(use)] = node
+        self._loop_map = self._index_loops()
+
+    # ------------------------------------------------------------ solving
+
+    def _solve(self) -> None:
+        worklist = list(self._nodes)
+        out_state: Dict[int, Dict[str, frozenset]] = {
+            id(n): {} for n in self._nodes
+        }
+        while worklist:
+            node = worklist.pop()
+            merged: Dict[str, set] = {}
+            for p in node.preds:
+                for name, defs in out_state[id(p)].items():
+                    merged.setdefault(name, set()).update(defs)
+            in_defs = {k: frozenset(v) for k, v in merged.items()}
+            out = dict(in_defs)
+            for name in node.kills:
+                out.pop(name, None)
+            for d in node.gen:
+                out[d.name] = frozenset((d,))
+            node.in_defs = in_defs
+            if out != out_state[id(node)]:
+                out_state[id(node)] = out
+                worklist.extend(node.succ)
+
+    # ------------------------------------------------------------- queries
+
+    def reaching(self, name_node: ast.Name) -> Tuple[Def, ...]:
+        """Definitions that may produce the value this ``Name`` load reads.
+        Empty for free variables (closure / global / builtin names) — those
+        are trace-time constants as far as the checkers care."""
+        return self._use_defs.get(id(name_node), ())
+
+    def is_use(self, name_node: ast.Name) -> bool:
+        return id(name_node) in self._use_defs
+
+    def may_follow(self, use_a: ast.Name, use_b: ast.Name) -> bool:
+        """May one run of the function evaluate ``use_a`` and then
+        ``use_b``?  False exactly when the CFG node owning ``use_b`` is
+        unreachable from the one owning ``use_a`` — e.g. exclusive
+        ``if``/``else`` arms (back edges make loop iterations count as
+        "following").  Conservatively True for nodes the CFG does not
+        own (defensive: every registered use has an owner)."""
+        a = self._use_owner.get(id(use_a))
+        b = self._use_owner.get(id(use_b))
+        if a is None or b is None or a is b:
+            return True
+        seen: Set[int] = {id(a)}
+        stack = list(a.succ)
+        while stack:
+            node = stack.pop()
+            if node is b:
+                return True
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.extend(node.succ)
+        return False
+
+    def _index_loops(self) -> Dict[int, List[ast.AST]]:
+        """id(ast node) -> enclosing For/While loops of this function (not
+        descending into nested defs)."""
+        out: Dict[int, List[ast.AST]] = {}
+
+        def walk(node: ast.AST, loops: List[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FN_NODES):
+                    continue
+                inner = loops
+                if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                    inner = loops + [child]
+                out[id(child)] = inner
+                walk(child, inner)
+
+        out[id(self.fn)] = []
+        walk(self.fn, [])
+        return out
+
+    def enclosing_loops(self, node: ast.AST) -> List[ast.AST]:
+        return self._loop_map.get(id(node), [])
+
+
+def function_flow(fn: ast.AST,
+                  cache: Optional[Dict[int, FunctionFlow]] = None) -> FunctionFlow:
+    """Build (or fetch from ``cache``) the :class:`FunctionFlow` for ``fn``."""
+    if cache is not None:
+        flow = cache.get(id(fn))
+        if flow is None:
+            flow = cache[id(fn)] = FunctionFlow(fn)
+        return flow
+    return FunctionFlow(fn)
+
+
+def tainted_uses(flow: FunctionFlow, seed_names: Iterable[str]) -> Set[int]:
+    """ids of ``Name``-load nodes whose value may derive from the named
+    parameters.
+
+    A definition is tainted when it is one of the seed parameter defs, or
+    when any ``Name`` load in its value expression may read a tainted
+    definition; a use is tainted when any of its reaching definitions is
+    tainted.  Free variables (closure constants, globals) never taint —
+    they are trace-time constants, which is exactly the false-positive
+    class this function exists to kill.
+    """
+    tainted: Set[int] = {
+        id(flow.param_defs[name])
+        for name in seed_names
+        if name in flow.param_defs
+    }
+    if not tainted:
+        return set()
+    changed = True
+    while changed:
+        changed = False
+        for d in flow.defs:
+            if id(d) in tainted:
+                continue
+            for use in d.use_nodes:
+                if any(id(r) in tainted for r in flow.reaching(use)):
+                    tainted.add(id(d))
+                    changed = True
+                    break
+    return {
+        uid
+        for uid, defs in flow._use_defs.items()
+        if any(id(r) in tainted for r in defs)
+    }
+
+
+def edit_distance(a: str, b: str, cap: int = 3) -> int:
+    """Levenshtein distance, early-exited at ``cap`` (returns ``cap`` when
+    the true distance is >= cap) — DK114's near-miss metric."""
+    if a == b:
+        return 0
+    if abs(len(a) - len(b)) >= cap:
+        return cap
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        best = i
+        for j, cb in enumerate(b, 1):
+            cost = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            cur.append(cost)
+            best = min(best, cost)
+        if best >= cap:
+            return cap
+        prev = cur
+    return min(prev[-1], cap)
