@@ -169,6 +169,47 @@ def test_walrus_binds_inside_the_test():
     assert [d.kind for d in flow.reaching(n_use)] == ["walrus"]
 
 
+def test_walrus_in_else_arm_does_not_reach_the_if_body():
+    # only the head expression's walruses belong to the head node: a
+    # binding inside the else arm must not flow into the (exclusive) if
+    # body, where the name is still unbound
+    flow = _flow(
+        "def f(xs, flag):\n"
+        "    if flag:\n"
+        "        return m\n"
+        "    else:\n"
+        "        return (m := len(xs))\n"
+    )
+    (m_use,) = _uses_of(flow, "m")
+    assert flow.reaching(m_use) == ()
+
+
+def test_walrus_in_loop_body_gen_at_its_own_statement_not_the_head():
+    flow = _flow(
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        y = (w := g(x))\n"
+        "    return w\n"
+    )
+    (w_use,) = _uses_of(flow, "w")
+    defs = flow.reaching(w_use)
+    assert [d.kind for d in defs] == ["walrus"]
+    # attributed to the assignment on line 3, not the for head on line 2
+    assert [d.stmt.lineno for d in defs] == [3]
+
+
+def test_walrus_in_raise_reaches_the_handler():
+    flow = _flow(
+        "def f(x):\n"
+        "    try:\n"
+        "        raise Err((v := g(x)))\n"
+        "    except Err:\n"
+        "        return v\n"
+    )
+    (v_use,) = _uses_of(flow, "v")
+    assert [d.kind for d in flow.reaching(v_use)] == ["walrus"]
+
+
 def test_free_variables_have_no_reaching_defs():
     flow = _flow(
         "def f(x):\n"

@@ -648,6 +648,10 @@ def test_cli_sarif_format_roundtrip():
     assert doc["version"] == "2.1.0"
     run = doc["runs"][0]
     assert run["tool"]["driver"]["name"] == "dklint"
+    # the schema requires informationUri, when present, to be an absolute
+    # URI — a repo-relative path breaks strict consumers
+    info = run["tool"]["driver"].get("informationUri")
+    assert info is None or "://" in info
     # every registered rule is described even though only DK104 fired
     rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
     assert rule_ids == sorted(all_rules())
@@ -703,6 +707,31 @@ def test_cli_since_filters_to_changed_files(tmp_path):
         cwd=tmp_path, env=env, capture_output=True, text=True,
     )
     assert clean.returncode == 0, clean.stdout + clean.stderr
+
+
+def test_cli_since_with_root_below_git_toplevel(tmp_path):
+    """`git diff` paths are cwd-relative (--relative), so a --root that is
+    a subdirectory of the git toplevel still matches root-relative
+    findings instead of silently filtering everything."""
+    _git(tmp_path, "init", "-q")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    mod.write_text("x = 1\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    mod.write_text(
+        "import jax\ndef g(x):\n    return jax.jit(lambda v: v)(x)\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", ".", "--no-baseline",
+         "--root", str(pkg), "--since", "HEAD", "--format", "json"],
+        cwd=pkg, env=env, capture_output=True, text=True,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert [(f["path"], f["rule"]) for f in payload] == [("mod.py", "DK102")]
 
 
 def test_cli_since_bad_ref_is_usage_error(tmp_path):

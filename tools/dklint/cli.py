@@ -24,7 +24,10 @@ def changed_files(root: str, ref: str) -> Set[str]:
     untracked files — the PR-diff set ``--since`` filters findings to."""
     out: Set[str] = set()
     for cmd in (
-        ["git", "diff", "--name-only", ref, "--"],
+        # --relative: diff paths come back relative to cwd (= root), like
+        # ls-files already does — findings are root-relative, and without
+        # it a --root below the git toplevel would never match anything
+        ["git", "diff", "--name-only", "--relative", ref, "--"],
         ["git", "ls-files", "--others", "--exclude-standard"],
     ):
         proc = subprocess.run(
@@ -81,9 +84,10 @@ def to_sarif(findings: Sequence[core.Finding]) -> dict:
         "runs": [
             {
                 "tool": {
+                    # no informationUri: the schema requires an absolute
+                    # URI and this in-repo tool has no canonical URL
                     "driver": {
                         "name": "dklint",
-                        "informationUri": "tools/dklint",
                         "rules": rules,
                     }
                 },

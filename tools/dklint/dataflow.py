@@ -123,10 +123,19 @@ class _Node:
         self.in_defs: Dict[str, frozenset] = {}
 
 
-def _walrus_defs(stmt: ast.AST) -> List[Def]:
-    """``(y := f(x))`` bindings anywhere in a statement's expressions."""
+def _walrus_defs(stmt: ast.AST,
+                 roots: Optional[Sequence[ast.AST]] = None) -> List[Def]:
+    """``(y := f(x))`` bindings in a statement's expressions.  For compound
+    statements pass ``roots`` (the head expressions only, e.g. ``stmt.test``
+    / ``stmt.iter``) — a walrus inside a body/orelse statement is gen'd at
+    that statement's own CFG node, and scanning the whole subtree from the
+    head would make it reach before its branch executes (e.g. a walrus in
+    the else arm spuriously reaching the if body)."""
     out: List[Def] = []
-    stack: List[ast.AST] = list(ast.iter_child_nodes(stmt))
+    stack: List[ast.AST] = (
+        [r for r in roots if r is not None] if roots is not None
+        else list(ast.iter_child_nodes(stmt))
+    )
     while stack:
         cur = stack.pop()
         if isinstance(cur, _FN_NODES):
@@ -165,7 +174,7 @@ class _CFGBuilder:
         if isinstance(stmt, ast.If):
             test = self._node(stmt)
             test.uses = _expr_uses(stmt.test)
-            test.gen = _walrus_defs(stmt)
+            test.gen = _walrus_defs(stmt, [stmt.test])
             self._connect(preds, test)
             body_out = self.block(stmt.body, [test])
             else_out = self.block(stmt.orelse, [test]) if stmt.orelse else [test]
@@ -174,7 +183,7 @@ class _CFGBuilder:
         if isinstance(stmt, ast.While):
             test = self._node(stmt)
             test.uses = _expr_uses(stmt.test)
-            test.gen = _walrus_defs(stmt)
+            test.gen = _walrus_defs(stmt, [stmt.test])
             self._connect(preds, test)
             breaks: List[_Node] = []
             self._loops.append((breaks, test))
@@ -189,7 +198,7 @@ class _CFGBuilder:
             head.uses = _expr_uses(stmt.iter)
             head.gen = [
                 Def(t.id, stmt, stmt.iter, "for") for t in _target_names(stmt.target)
-            ] + _walrus_defs(stmt)
+            ] + _walrus_defs(stmt, [stmt.iter])
             self._connect(preds, head)
             breaks = []
             self._loops.append((breaks, head))
@@ -230,13 +239,19 @@ class _CFGBuilder:
                         Def(t.id, stmt, item.context_expr, "with")
                         for t in _target_names(item.optional_vars)
                     )
-            head.gen.extend(_walrus_defs(stmt))
+            head.gen.extend(
+                _walrus_defs(stmt, [item.context_expr for item in stmt.items])
+            )
             self._connect(preds, head)
             return self.block(stmt.body, [head])
 
         if isinstance(stmt, (ast.Return, ast.Raise)):
             n = self._node(stmt)
             n.uses = _expr_uses(stmt)
+            # a walrus in the returned/raised expression is observable past
+            # this node (a try-body raise transfers its out state to the
+            # handlers)
+            n.gen = _walrus_defs(stmt)
             self._connect(preds, n)
             self._connect([n], self.exit)
             return []
