@@ -1327,6 +1327,89 @@ def run_serving(n_requests: int = 64, num_slots: int = 8, page_size: int = 16,
     return row
 
 
+def run_datapipe(n: int = 8192, feature_dim: int = 64, batch: int = 64,
+                 window: int = 4, num_workers: int = 8, k: int = 3,
+                 reps: int = 3) -> list:
+    """Host-only datapipe throughput rows (``--datapipe``).
+
+    Entirely device-free — ``epoch_window_iter`` + :class:`PrefetchRing`
+    with no ``put_fn`` — so it runs before backend init and survives any
+    CPU fallback; the rows measure the data plane the trainers feed from,
+    not the accelerator behind it.  Three rows:
+
+    * ``datapipe_blocks_per_sec`` — window blocks pulled through the ring
+      per second (median of ``k`` sets of ``reps`` epochs), with
+      ``stall_fraction`` = consumer wait / wall: ~0 means the producer kept
+      the ring full; ->1 means the source bounds the pipeline.
+    * ``datapipe_source_blocks_per_sec`` — the same iterator WITHOUT the
+      ring (the producer's ceiling; ring overhead = the gap).
+    * ``datapipe_packing_efficiency`` — real tokens / (rows * width) from
+      :func:`pack_sequences` over a log-normal ragged length mix, with the
+      padding fraction a fixed-width loader would have paid.
+    """
+    from distkeras_tpu.data import epoch_window_iter
+    from distkeras_tpu.datapipe import PrefetchRing, pack_sequences
+
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(n, feature_dim)).astype(np.float32)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+
+    def one_epoch(prefetch):
+        it = epoch_window_iter(feats, labels, num_workers, batch, window)
+        ring = PrefetchRing(it, depth=2) if prefetch else it
+        blocks = 0
+        for _ in ring:
+            blocks += 1
+        stall = ring.stall_seconds if prefetch else 0.0
+        return blocks, stall
+
+    def timed(prefetch):
+        vals, stalls = [], []
+        for _ in range(max(1, k)):
+            t0 = time.perf_counter()
+            blocks = stall = 0
+            for _ in range(reps):
+                b, s = one_epoch(prefetch)
+                blocks += b
+                stall += s
+            wall = time.perf_counter() - t0
+            vals.append(blocks / wall)
+            stalls.append(stall / wall)
+        return statistics.median(vals), statistics.median(stalls)
+
+    timed(True)  # warmup: page in the arrays, spin up a first thread
+    ring_bps, stall_frac = timed(True)
+    src_bps, _ = timed(False)
+
+    # Packing: log-normal lengths (the LM-corpus shape), width 256.
+    width = 256
+    lengths = np.clip(rng.lognormal(4.0, 0.8, size=512).astype(int), 2, width)
+    seqs = [rng.integers(1, 1000, size=int(m)).astype(np.int32) for m in lengths]
+    packed = pack_sequences(seqs, width)
+    real = int(sum(len(s) for s in seqs))
+    eff = real / float(packed.tokens.shape[0] * width)
+    fixed_width_pad = 1.0 - real / float(len(seqs) * width)
+
+    proto = "host-only: epoch_window_iter through PrefetchRing(depth=2), no device"
+    return [
+        {"metric": "datapipe_blocks_per_sec", "value": round(ring_bps, 1),
+         "unit": "window blocks/sec through the prefetch ring",
+         "vs_baseline": None, "stall_fraction": round(stall_frac, 4),
+         "num_workers": num_workers, "batch": batch, "window": window,
+         "protocol": proto},
+        {"metric": "datapipe_source_blocks_per_sec", "value": round(src_bps, 1),
+         "unit": "window blocks/sec from the bare iterator (no ring)",
+         "vs_baseline": None, "protocol": proto},
+        {"metric": "datapipe_packing_efficiency", "value": round(eff, 4),
+         "unit": "real tokens / packed capacity",
+         "vs_baseline": None, "sequences": len(seqs), "width": width,
+         "rows": int(packed.tokens.shape[0]),
+         "fixed_width_padding_fraction": round(fixed_width_pad, 4),
+         "protocol": "first-fit-decreasing pack_sequences over log-normal "
+                     "lengths (clip 2..width)"},
+    ]
+
+
 def write_baseline(results: dict) -> None:
     """Pin the current sweep as the regression baseline, stamped with the
     protocol it was measured under (``--write-baseline``)."""
@@ -1363,6 +1446,10 @@ def main():
     parser.add_argument("--serving", action="store_true",
                         help="append an online-serving SLO line (continuous "
                         "batching tokens/sec + TTFT/latency quantiles)")
+    parser.add_argument("--datapipe", action="store_true",
+                        help="emit host-only data-plane rows (prefetch-ring "
+                        "blocks/sec + stall fraction, packing efficiency) "
+                        "and exit — needs no accelerator backend")
     parser.add_argument("--write-baseline", action="store_true",
                         help="pin this sweep's medians (+ protocol) as "
                         "bench_baseline.json")
@@ -1401,6 +1488,18 @@ def main():
     if args.write_baseline and (args.tiny or args.tiny_calibrate or args.cpu):
         parser.error("--write-baseline pins regression baselines; it needs "
                      "real TPU measurements (drop --tiny/--cpu)")
+    if args.datapipe:
+        # Host-only fast path: no backend init, no deadman.  The rows
+        # measure the data plane itself and must come out identically on a
+        # machine with no accelerator at all (the CI smoke leg runs this
+        # under JAX_PLATFORMS=cpu and asserts the rows appear).
+        try:
+            for row in run_datapipe():
+                print(_ok_line(row))
+        except Exception as e:  # noqa: BLE001 — one JSON line, always
+            _emit_error(f"{type(e).__name__}: {e}",
+                        metric="datapipe_blocks_per_sec")
+        return
     if args.cpu:
         import jax
 

@@ -15,6 +15,7 @@ end of the epoch loop, and implicitly before any restore) flushes the queue.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Optional
 
@@ -27,6 +28,7 @@ __all__ = [
     "save_checkpoint", "restore_checkpoint", "restore_center",
     "model_state_worker_mean", "latest_step",
     "checkpoint_num_workers", "CheckpointManager",
+    "save_data_state", "restore_data_state",
 ]
 
 _CHECKPOINTER = None
@@ -65,9 +67,16 @@ def wait_until_finished() -> None:
             _CHECKPOINTER.wait_until_finished()
 
 
-def save_checkpoint(directory: str, state: Any, step: int) -> str:
+def save_checkpoint(directory: str, state: Any, step: int,
+                    force: bool = False) -> str:
     """Write training state under ``directory/step_N`` (async); returns the
-    path.  Call :func:`wait_until_finished` before reading it back."""
+    path.  Call :func:`wait_until_finished` before reading it back.
+
+    ``force=True`` overwrites an existing ``step_N`` — the mid-epoch
+    (datapipe) save path, where the same step id is re-saved as the block
+    cursor advances and finally superseded by the epoch-boundary save.  A
+    forced save flushes the async queue first so it cannot race an
+    in-flight write to the same path."""
     import orbax.checkpoint as ocp
 
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
@@ -75,12 +84,53 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
     # save: the host snapshot plus handing the write to Orbax's thread.
     with telemetry.trace.span("checkpoint_enqueue", phase="ckpt", step=int(step)):
         host_state = jax.tree.map(np.asarray, state)
-        _checkpointer().save(path, args=ocp.args.StandardSave(host_state))
+        if force:
+            _checkpointer().wait_until_finished()
+        _checkpointer().save(
+            path, args=ocp.args.StandardSave(host_state), force=force)
     if telemetry.enabled():
         telemetry.metrics.counter(
             "checkpoints_saved_total", help="async checkpoint saves enqueued"
         ).inc()
     return path
+
+
+def data_state_path(directory: str, step: int) -> str:
+    """The ``step_<n>_data.json`` sidecar carrying a step's
+    :class:`~distkeras_tpu.datapipe.DataState`.  A plain file (no ``step_<n>``
+    *directory* name), so :func:`committed_steps`'s digit parse never
+    mistakes it for a checkpoint step."""
+    return os.path.join(os.path.abspath(directory), f"step_{step}_data.json")
+
+
+def save_data_state(directory: str, data_state, step: int) -> str:
+    """Write the data checkpoint sidecar for ``step`` — synchronous (a few
+    hundred bytes) and atomic (tmp + rename), so a crash can never leave a
+    half-written cursor next to a committed model step."""
+    path = data_state_path(directory, step)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data_state.to_json(), fh)
+    os.replace(tmp, path)
+    return path
+
+
+def restore_data_state(directory: str, step: Optional[int] = None):
+    """The :class:`~distkeras_tpu.datapipe.DataState` saved with ``step``
+    (default: latest), or None — model-only checkpoints (pre-datapipe runs,
+    external writers) resume with the legacy epoch-boundary RNG
+    fast-forward instead."""
+    from distkeras_tpu.datapipe.state import DataState
+
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            return None
+    path = data_state_path(directory, step)
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return DataState.from_json(json.load(fh))
 
 
 def committed_steps(directory: str) -> list:
@@ -279,15 +329,59 @@ class CheckpointManager:
         self.every = max(1, int(every))
         self.keep = keep
         self._saved: set[int] = set()
+        # steps whose latest save is a mid-epoch (partial) one: their
+        # epoch-boundary save must overwrite (force=True), and their stale
+        # cursor sidecar must go when the boundary save supersedes it
+        self._partial: set[int] = set()
         os.makedirs(self.directory, exist_ok=True)
 
-    def maybe_save(self, state: Any, epoch: int) -> Optional[str]:
+    def _is_partial(self, step: int) -> bool:
+        """Whether ``step``'s latest save is a mid-epoch one — from this
+        manager's memory, or from the on-disk cursor sidecar (sidecar writes
+        are synchronous, so a resumed process sees a killed run's partial
+        step even while its async model save is still uncommitted)."""
+        if step in self._partial:
+            return True
+        ds = restore_data_state(self.directory, step)
+        return ds is not None and int(ds.block_cursor) > 0
+
+    def maybe_save(self, state: Any, epoch: int,
+                   data_state=None) -> Optional[str]:
         if (epoch + 1) % self.every:
             return None
-        path = save_checkpoint(self.directory, state, epoch + 1)
-        self._saved.add(epoch + 1)
+        step = epoch + 1
+        path = save_checkpoint(self.directory, state, step,
+                               force=self._is_partial(step))
+        if data_state is not None:
+            save_data_state(self.directory, data_state, step)
+        else:
+            # boundary save without a DataState supersedes a mid-epoch one:
+            # drop any stale cursor so resume doesn't skip blocks
+            try:
+                os.remove(data_state_path(self.directory, step))
+            except FileNotFoundError:
+                pass
+        self._partial.discard(step)
+        self._saved.add(step)
         self._gc()
         return path
+
+    def save_partial(self, state: Any, epoch: int, data_state) -> str:
+        """Mid-epoch save: model state plus the :class:`DataState` cursor
+        marking how far into ``epoch``'s block sequence the run got.  Saved
+        under the step the epoch-boundary save will later claim
+        (``epoch + 1``) and re-saved in place (``force=True``) as the cursor
+        advances — resume always sees one coherent (state, cursor) pair."""
+        step = epoch + 1
+        path = save_checkpoint(self.directory, state, step, force=True)
+        save_data_state(self.directory, data_state, step)
+        self._partial.add(step)
+        self._saved.add(step)
+        self._gc()
+        return path
+
+    def restore_data_state(self, step: Optional[int] = None):
+        return restore_data_state(self.directory, step)
 
     def wait(self) -> None:
         """Flush in-flight async saves (end of the trainer epoch loop)."""
@@ -310,7 +404,12 @@ class CheckpointManager:
         committed = committed_steps(self.directory)
         for s in committed[: -self.keep] if self.keep else []:
             self._saved.discard(s)
+            self._partial.discard(s)
             shutil.rmtree(os.path.join(self.directory, f"step_{s}"), ignore_errors=True)
+            try:
+                os.remove(data_state_path(self.directory, s))
+            except FileNotFoundError:
+                pass
 
     def latest(self) -> Optional[int]:
         self.wait()  # flush + exact keep policy before reading the record

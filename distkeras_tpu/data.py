@@ -77,6 +77,7 @@ def epoch_window_iter(
     rng: Optional[np.random.Generator] = None,
     pad_to_window: bool = True,
     feature_dtype=None,
+    start_block: int = 0,
 ):
     """Lazily yield one epoch as per-window blocks
     ``[num_workers, window, batch, ...]`` — the streaming twin of
@@ -102,6 +103,13 @@ def epoch_window_iter(
     one pass over the data, half the bytes toward the device — the host
     half of the streaming path's compute-dtype transfer.  Value-identical
     to casting after the gather.
+
+    ``start_block=k`` skips the first ``k`` windows by index arithmetic
+    alone (no gather is paid for skipped blocks) while still drawing the
+    full shuffle from ``rng`` — the datapipe resume path
+    (:class:`distkeras_tpu.datapipe.DataState`): restore the RNG bit state
+    captured before the epoch's shuffle, skip the consumed blocks, and the
+    remaining blocks are bitwise the uninterrupted epoch's tail.
     """
     n = len(features)
     if n == 0:
@@ -128,7 +136,13 @@ def epoch_window_iter(
         and np.issubdtype(features.dtype, np.floating)
     )
     gather_x = native.gather_rows_bf16 if fused_bf16 else native.gather_rows
-    for w in range(n_windows):
+    start_block = int(start_block)
+    if not 0 <= start_block <= n_windows:
+        raise ValueError(
+            f"start_block {start_block} outside this epoch's "
+            f"[0, {n_windows}] window range"
+        )
+    for w in range(start_block, n_windows):
         block = idx2[:, w * window : (w + 1) * window]
         cur = block.shape[1]  # < window only for a ragged final block
         sel = np.ascontiguousarray(block).ravel()

@@ -1,0 +1,39 @@
+"""Data plane — sharded, prefetching, resumable input pipeline.
+
+ROADMAP item 5: the training input path grows from the Spark-DataFrame-shaped
+host loop (:mod:`distkeras_tpu.frame` / :mod:`distkeras_tpu.data`) into a
+subsystem of its own, without changing a single trained bit:
+
+* :mod:`~distkeras_tpu.datapipe.source` — where rows live: in-memory arrays /
+  DataFrame columns, or memory-mapped ``.npy`` file shards, each host holding
+  only its slice (sharding keyed on ``jax.process_index()``).
+* :mod:`~distkeras_tpu.datapipe.ring` — :class:`PrefetchRing`, a bounded
+  background-thread ring that pulls blocks through the existing
+  ``epoch_window_iter`` layout (bitwise-identical row order, including the
+  fused bf16 gather+cast) and optionally runs the engine's device-put stage
+  off-thread, feeding ``run_epoch_streaming`` unchanged via its
+  ``window_iter`` contract.
+* :mod:`~distkeras_tpu.datapipe.packing` — :func:`pack_sequences`, bin-packing
+  ragged token sequences into fixed-width rows with segment IDs for the
+  intra-segment causal attention path in TransformerLM/StagedLM.
+* :mod:`~distkeras_tpu.datapipe.state` — :class:`DataState`, the deterministic
+  data checkpoint (epoch, block cursor, RNG bit-generator state) saved next to
+  model checkpoints by :mod:`distkeras_tpu.checkpoint` so a killed run resumes
+  mid-epoch on the identical remaining-block sequence.
+"""
+
+from distkeras_tpu.datapipe.packing import PackedBatch, pack_sequences
+from distkeras_tpu.datapipe.ring import PrefetchRing
+from distkeras_tpu.datapipe.source import ArraySource, MemmapSource, Source, host_shard
+from distkeras_tpu.datapipe.state import DataState
+
+__all__ = [
+    "ArraySource",
+    "DataState",
+    "MemmapSource",
+    "PackedBatch",
+    "PrefetchRing",
+    "Source",
+    "host_shard",
+    "pack_sequences",
+]

@@ -61,11 +61,14 @@ class _Embed(nn.Module):
     max_len: int
 
     @nn.compact
-    def __call__(self, tokens, offset=0):
+    def __call__(self, tokens, offset=0, positions=None):
         tokens = tokens.astype(jnp.int32)
-        positions = offset + jnp.arange(tokens.shape[1])
         x = nn.Embed(self.vocab_size, self.dim, name="tok_embed")(tokens)
-        return x + nn.Embed(self.max_len, self.dim, name="pos_embed")(positions)[None]
+        pos_embed = nn.Embed(self.max_len, self.dim, name="pos_embed")
+        if positions is not None:
+            # sequence packing: batched [b, width] per-segment positions
+            return x + pos_embed(positions)
+        return x + pos_embed(offset + jnp.arange(tokens.shape[1]))[None]
 
 
 class _Head(nn.Module):
@@ -150,15 +153,18 @@ class StagedTransformer(ModelAdapter):
 
     # ------------------------------------------------- stage pieces (public
     # to the pipeline engine; all pure functions of explicit params)
-    def embed(self, embed_params, tokens, offset=0):
-        return self._embed.apply({"params": embed_params}, tokens, offset)
+    def embed(self, embed_params, tokens, offset=0, positions=None):
+        return self._embed.apply({"params": embed_params}, tokens, offset,
+                                 positions)
 
-    def stage(self, stage_params, h):
+    def stage(self, stage_params, h, segment_ids=None):
         """Apply one stage: scan ``blocks_per_stage`` blocks whose param
-        leaves carry a leading ``[blocks_per_stage]`` axis."""
+        leaves carry a leading ``[blocks_per_stage]`` axis.  ``segment_ids``
+        (sequence packing) threads to every block's attention mask."""
 
         def body(x, p):
-            return self._block.apply({"params": p}, x), None
+            return self._block.apply(
+                {"params": p}, x, segment_ids=segment_ids), None
 
         h, _ = lax.scan(body, h, stage_params)
         return h
@@ -187,9 +193,17 @@ class StagedLM(StagedTransformer):
     with ``loss="token_crossentropy"``; the engines shard the integer
     label array like the tokens (``per_token_labels``).  Output width is
     ``vocab_size``; the inherited ``num_classes`` field does not apply.
+
+    ``packed=True`` consumes sequence-packed ``[batch, width, 2]`` input
+    (token + segment-ID channels, :meth:`PackedBatch.model_inputs`) through
+    the *sequential* executor: per-segment positions, intra-segment
+    attention masks, train with ``loss="masked_token_crossentropy"``.
+    The pipeline schedule (``pipeline_stages>1``) does not thread segment
+    IDs — train packed StagedLMs on the windowed/GSPMD engines.
     """
 
     per_token_labels: bool = True
+    packed: bool = False
 
     def __post_init__(self):
         if self.num_classes != type(self).num_classes:
@@ -210,6 +224,36 @@ class StagedLM(StagedTransformer):
 
     def _make_head(self):
         return _LMHead(self.vocab_size, ln_eps=self.ln_eps)
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng: jax.Array, sample_input) -> Tuple[Any, Any]:
+        if self.packed:
+            # init on the token channel: the packed and unpacked executors
+            # share one param tree (the parity test swaps params between them)
+            sample_input = jnp.asarray(sample_input)[..., 0]
+        return super().init(rng, sample_input)
+
+    # ----------------------------------------------------------- sequential
+    def apply(self, params, state, inputs, training=False, rng=None):
+        if not self.packed:
+            return super().apply(params, state, inputs, training, rng)
+        if self.seq_axis is not None:
+            raise ValueError(
+                "packed=True is incompatible with seq_axis (ring attention "
+                "has no segment-mask block structure)"
+            )
+        from distkeras_tpu.models.transformer import packed_positions
+
+        tokens = inputs[..., 0]
+        segment_ids = inputs[..., 1].astype(jnp.int32)
+        h = self.embed(params["embed"], tokens,
+                       positions=packed_positions(segment_ids))
+
+        def body(x, p):
+            return self.stage(p, x, segment_ids=segment_ids), None
+
+        h, _ = lax.scan(body, h, params["blocks"])
+        return self.head(params["head"], h), state
 
     # ------------------------------------------------------- KV-cache decode
     def init_cache(self, batch_size: int, dtype=jnp.float32):

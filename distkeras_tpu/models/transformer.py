@@ -23,7 +23,25 @@ from jax import lax
 
 from distkeras_tpu.parallel.ring import attention, ring_attention
 
-__all__ = ["TransformerClassifier", "TransformerEncoderBlock", "TransformerLM"]
+__all__ = ["TransformerClassifier", "TransformerEncoderBlock", "TransformerLM",
+           "packed_positions"]
+
+
+def packed_positions(segment_ids):
+    """Per-segment positions ``[batch, width]`` from packed segment IDs
+    (:func:`distkeras_tpu.datapipe.pack_sequences` convention: monotone
+    per-row, 0 = pad): each token's index minus the index of its segment's
+    first token, so every segment sees the positions ``0..len-1`` a
+    standalone sequence would — computed on device with a cummax over
+    segment starts (no host round-trip, no python loop)."""
+    segment_ids = jnp.asarray(segment_ids)
+    idx = jnp.arange(segment_ids.shape[1], dtype=jnp.int32)
+    prev = jnp.concatenate(
+        [jnp.full_like(segment_ids[:, :1], -1), segment_ids[:, :-1]], axis=1
+    )
+    is_start = segment_ids != prev
+    start = lax.cummax(jnp.where(is_start, idx[None], 0), axis=1)
+    return idx[None] - start
 
 
 class _SelfAttention(nn.Module):
@@ -34,16 +52,29 @@ class _SelfAttention(nn.Module):
     max_len: Optional[int] = None  # KV-cache capacity for decode mode
 
     @nn.compact
-    def __call__(self, x, training: bool = False, decode: bool = False):
+    def __call__(self, x, training: bool = False, decode: bool = False,
+                 segment_ids=None):
         head_dim = self.dim // self.heads
         qkv = nn.DenseGeneral((3, self.heads, head_dim), name="qkv")(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
         if decode:
+            if segment_ids is not None:
+                raise ValueError(
+                    "segment_ids (sequence packing) is a training-path "
+                    "feature; decode serves one sequence per row"
+                )
             out = self._decode_attention(q, k, v)
         elif self.seq_axis is not None:
+            if segment_ids is not None:
+                raise ValueError(
+                    "segment_ids is incompatible with seq_axis: ring "
+                    "attention has no segment-mask block structure — pack "
+                    "with seq_axis=None"
+                )
             out = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
         else:
-            out = attention(q, k, v, causal=self.causal)
+            out = attention(q, k, v, causal=self.causal,
+                            segment_ids=segment_ids)
         return nn.DenseGeneral(self.dim, axis=(-2, -1), name="proj")(out)
 
     def _decode_attention(self, q, k, v):
@@ -100,10 +131,12 @@ class TransformerEncoderBlock(nn.Module):
     ln_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5 (models/hf_staged.py)
 
     @nn.compact
-    def __call__(self, x, training: bool = False, decode: bool = False):
+    def __call__(self, x, training: bool = False, decode: bool = False,
+                 segment_ids=None):
         h = nn.LayerNorm(epsilon=self.ln_eps)(x)
         h = _SelfAttention(self.dim, self.heads, self.seq_axis, self.causal,
-                           self.max_len)(h, training, decode)
+                           self.max_len)(h, training, decode,
+                                         segment_ids=segment_ids)
         if self.dropout > 0:
             h = nn.Dropout(self.dropout, deterministic=not training)(h)
         x = x + h
@@ -118,25 +151,32 @@ class TransformerEncoderBlock(nn.Module):
 
 def _encode_tokens(tokens, *, vocab_size, dim, heads, num_layers, max_len,
                    seq_axis, causal, dropout, training, decode=False,
-                   pos_offset=None):
+                   pos_offset=None, positions=None, segment_ids=None):
     """Shared classifier/LM trunk: token + (block-offset) positional
     embeddings, encoder-block stack, final LayerNorm.  Must be called from
     inside an ``@nn.compact`` ``__call__`` — the modules it instantiates
-    attach to the caller's scope (flat param names)."""
+    attach to the caller's scope (flat param names).
+
+    ``positions`` (``[batch, width]``, sequence packing) overrides the
+    arange-derived positions with per-segment ones; ``segment_ids`` threads
+    down to every block's attention mask."""
     tokens = tokens.astype(jnp.int32)
     block_len = tokens.shape[1]
-    if pos_offset is not None:
-        offset = pos_offset
-    else:
-        offset = lax.axis_index(seq_axis) * block_len if seq_axis is not None else 0
-    positions = offset + jnp.arange(block_len)
     x = nn.Embed(vocab_size, dim, name="tok_embed")(tokens)
-    x = x + nn.Embed(max_len, dim, name="pos_embed")(positions)[None]
+    pos_embed = nn.Embed(max_len, dim, name="pos_embed")
+    if positions is not None:
+        x = x + pos_embed(positions)
+    else:
+        if pos_offset is not None:
+            offset = pos_offset
+        else:
+            offset = lax.axis_index(seq_axis) * block_len if seq_axis is not None else 0
+        x = x + pos_embed(offset + jnp.arange(block_len))[None]
     for i in range(num_layers):
         x = TransformerEncoderBlock(
             dim, heads, seq_axis=seq_axis, causal=causal,
             dropout=dropout, max_len=max_len, name=f"block_{i}",
-        )(x, training, decode)
+        )(x, training, decode, segment_ids=segment_ids)
     return nn.LayerNorm()(x)
 
 
@@ -151,6 +191,16 @@ class TransformerLM(nn.Module):
     engine) stay block-local, so memory per device is O(seq/shards).
     Train with ``loss="token_crossentropy"`` /
     ``metrics=("token_accuracy",)``.
+
+    ``packed=True`` consumes sequence-packed input
+    (:func:`distkeras_tpu.datapipe.pack_sequences`): ``[batch, width, 2]``
+    int32 with token and segment-ID channels
+    (:meth:`PackedBatch.model_inputs`).  Positions restart per segment and
+    attention is masked intra-segment, so each packed segment's logits
+    equal the logits the sequence would get alone in a row
+    (tests/test_datapipe.py pins this).  Train packed models with
+    ``loss="masked_token_crossentropy"`` — the packer marks pads and
+    segment tails with ``-1`` labels.
     """
 
     vocab_size: int
@@ -160,6 +210,7 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     seq_axis: Optional[str] = None
     dropout: float = 0.0
+    packed: bool = False
 
     #: engines shard the label array like the token array (per-token labels)
     per_token_labels = True
@@ -167,6 +218,22 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, training: bool = False, decode: bool = False):
         pos_offset = None
+        positions = None
+        segment_ids = None
+        if self.packed:
+            if decode:
+                raise ValueError(
+                    "packed=True is a training-path layout; decode with a "
+                    "packed=False twin (same params)"
+                )
+            if self.seq_axis is not None:
+                raise ValueError(
+                    "packed=True is incompatible with seq_axis (ring "
+                    "attention has no segment-mask block structure)"
+                )
+            tokens, segment_ids = tokens[..., 0], tokens[..., 1]
+            segment_ids = segment_ids.astype(jnp.int32)
+            positions = packed_positions(segment_ids)
         if decode:
             # decode chunks carry no absolute positions; a top-level cache
             # cursor supplies them (prefill advances it by the prompt length,
@@ -180,6 +247,7 @@ class TransformerLM(nn.Module):
             num_layers=self.num_layers, max_len=self.max_len,
             seq_axis=self.seq_axis, causal=True, dropout=self.dropout,
             training=training, decode=decode, pos_offset=pos_offset,
+            positions=positions, segment_ids=segment_ids,
         )
         return nn.Dense(self.vocab_size, name="lm_head")(x)
 

@@ -79,6 +79,31 @@ def _token_crossentropy(from_logits: bool):
     return loss
 
 
+def _masked_token_crossentropy(from_logits: bool):
+    """:func:`_token_crossentropy` with an ignore label: positions whose
+    label is ``< 0`` (the sequence-packing convention — pads and segment
+    tails carry ``-1``, :mod:`distkeras_tpu.datapipe.packing`) contribute
+    nothing, and the mean runs over real tokens only.  The clamp to 0 keeps
+    the gather in-range; its contribution is zeroed by the mask."""
+
+    def loss(preds, labels):
+        labels = jnp.asarray(labels).astype(jnp.int32)
+        mask = (labels >= 0).astype(preds.dtype)
+        safe = jnp.maximum(labels, 0)
+        if from_logits:
+            per_tok = optax.softmax_cross_entropy_with_integer_labels(
+                preds, safe
+            )
+        else:
+            p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+            per_tok = -jnp.log(
+                jnp.take_along_axis(p, safe[..., None], axis=-1)[..., 0]
+            )
+        return (per_tok * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    return loss
+
+
 def get_loss(spec, from_logits: bool = True) -> Callable:
     """Resolve a Keras-style loss string (or pass through a callable)."""
     if callable(spec):
@@ -86,6 +111,8 @@ def get_loss(spec, from_logits: bool = True) -> Callable:
     name = str(spec).lower()
     if name in ("token_crossentropy", "lm_crossentropy"):
         return _token_crossentropy(from_logits)
+    if name in ("masked_token_crossentropy", "packed_crossentropy"):
+        return _masked_token_crossentropy(from_logits)
     if name in ("categorical_crossentropy", "sparse_categorical_crossentropy", "crossentropy"):
         return _categorical_crossentropy(from_logits)
     if name in ("binary_crossentropy",):

@@ -1097,8 +1097,33 @@ class WindowedEngine:
             if not (key[0] == "multi" and key[-2:] == tuple(keep_multi)):
                 del self._epoch_fns[key]
 
+    def stream_put(self, block):
+        """Cast + shard one streamed window block ``(xs, ys)`` shaped
+        ``[num_workers, window, batch, ...]`` onto the mesh — the h2d half
+        of the streaming path, factored out so the datapipe
+        :class:`~distkeras_tpu.datapipe.PrefetchRing` can run it as its
+        device-put stage on the producer thread (h2d then overlaps the next
+        gather); :meth:`run_epoch_streaming` recognises blocks that arrive
+        already device-resident and skips its own put.
+
+        Float features ship pre-cast to the compute dtype: the first thing
+        the local step does with x is cast it (``_local_step``), so casting
+        on host instead is value-identical — and through a bandwidth-bound
+        link (axon tunnel: ~35-85 MB/s measured; even PCIe at dataset
+        scale) bf16 halves the bytes of the dominant cost (PERF.md §8).
+        """
+        xs, ys = block
+        cast = self.compute_dtype
+        if cast is not None and jnp.issubdtype(xs.dtype, jnp.floating):
+            # copy=False: blocks from the fused native gather+cast
+            # (data.epoch_window_iter(feature_dtype=...)) arrive already
+            # in the compute dtype — don't pay a second host copy
+            xs = xs.astype(cast, copy=False)
+        return self.shard_batches(xs[:, None], ys[:, None])
+
     def run_epoch_streaming(self, state: TrainState, window_iter,
-                            prefetch: int = 2, strict_link=None):
+                            prefetch: int = 2, strict_link=None,
+                            on_window=None):
         """Run one epoch from a host-side iterator of per-window blocks
         ``(xs, ys)`` shaped ``[num_workers, window, batch, ...]`` (see
         :func:`distkeras_tpu.data.epoch_window_iter`).
@@ -1122,6 +1147,13 @@ class WindowedEngine:
         or raises when ``strict_link=True`` (default: the
         ``DISTKERAS_STREAMING_STRICT`` env var).  The measured report is
         kept on ``self.last_stream_report`` for bench/debug.
+
+        ``on_window(state, n)`` (optional) fires after window ``n`` (1-based)
+        has been dispatched — the trainers' mid-epoch checkpoint hook (model
+        state + datapipe block cursor).  ``window_iter.close()``, when it
+        exists (generators, the datapipe PrefetchRing), is called on every
+        exit path, so an error mid-epoch drains a prefetch ring instead of
+        orphaning its thread.
         """
         if self.commit_schedule is not None:
             raise ValueError(
@@ -1136,21 +1168,6 @@ class WindowedEngine:
         if strict_link is None:
             strict_link = os.environ.get(
                 "DISTKERAS_STREAMING_STRICT", "").lower() not in ("", "0", "false")
-
-        # Ship float features pre-cast to the compute dtype: the first thing
-        # the local step does with x is cast it (``_local_step``), so casting
-        # on host instead is value-identical — and through a bandwidth-bound
-        # link (axon tunnel: ~35-85 MB/s measured; even PCIe at dataset
-        # scale) bf16 halves the bytes of the dominant cost (PERF.md §8).
-        cast = self.compute_dtype
-        def put(block):
-            xs, ys = block
-            if cast is not None and jnp.issubdtype(xs.dtype, jnp.floating):
-                # copy=False: blocks from the fused native gather+cast
-                # (data.epoch_window_iter(feature_dtype=...)) arrive already
-                # in the compute dtype — don't pay a second host copy
-                xs = xs.astype(cast, copy=False)
-            return self.shard_batches(xs[:, None], ys[:, None])
 
         it = iter(window_iter)
         buf = deque()
@@ -1172,49 +1189,62 @@ class WindowedEngine:
             t0 = time.perf_counter()
             block = next(it, None)
             if block is not None:
-                steps_list.append(block[0].shape[1])
-                block = put(block)
+                if isinstance(block[0], jax.Array):
+                    # the datapipe ring's device-put stage already ran
+                    # stream_put on its producer thread: the block arrives
+                    # sharded [num_workers, 1, window, batch, ...]
+                    steps_list.append(int(block[0].shape[2]))
+                else:
+                    steps_list.append(block[0].shape[1])
+                    block = self.stream_put(block)
             dt = time.perf_counter() - t0
             src_seconds += dt
             if steady_t0 is not None:
                 steady_src += dt
             return block
 
-        while True:
-            if not buf:
-                block = pull()
-                if block is None:
-                    break
-                buf.append(block)
-            xs, ys = buf.popleft()
-            # async dispatch; sync_telemetry=False because blocking here
-            # would serialise the pipeline — spans are recorded at the real
-            # sync point (the backpressure wait) instead
-            with telemetry.trace.span("window_dispatch", window=n_windows):
-                state, stats = self.run_epoch(
-                    state, xs, ys, sync_telemetry=False)
-            n_windows += 1
-            stats_list.append(stats)
-            # Backpressure: dispatch is async, so without a sync the host
-            # would device_put the whole epoch ahead of the device and defeat
-            # the memory bound.  Waiting on the loss of the window dispatched
-            # `prefetch` calls ago caps in-flight windows at prefetch (plus
-            # up to prefetch buffered undispatched blocks — see docstring).
-            if n_windows > depth:
-                with telemetry.trace.span("window_wait", phase="step",
-                                          window=n_windows - 1 - depth):
-                    jax.block_until_ready(stats_list[n_windows - 1 - depth]["loss"])
-                if steady_t0 is None:
-                    steady_t0 = time.perf_counter()
-            # Refill AFTER dispatching (first window included): the very
-            # first window's compute then hides the rest of the initial
-            # prefill's source latency — measured, not assumed, in
-            # tests/test_streaming_overlap.py.
-            while len(buf) < depth:
-                block = pull()
-                if block is None:
-                    break
-                buf.append(block)
+        try:
+            while True:
+                if not buf:
+                    block = pull()
+                    if block is None:
+                        break
+                    buf.append(block)
+                xs, ys = buf.popleft()
+                # async dispatch; sync_telemetry=False because blocking here
+                # would serialise the pipeline — spans are recorded at the real
+                # sync point (the backpressure wait) instead
+                with telemetry.trace.span("window_dispatch", window=n_windows):
+                    state, stats = self.run_epoch(
+                        state, xs, ys, sync_telemetry=False)
+                n_windows += 1
+                stats_list.append(stats)
+                if on_window is not None:
+                    on_window(state, n_windows)
+                # Backpressure: dispatch is async, so without a sync the host
+                # would device_put the whole epoch ahead of the device and defeat
+                # the memory bound.  Waiting on the loss of the window dispatched
+                # `prefetch` calls ago caps in-flight windows at prefetch (plus
+                # up to prefetch buffered undispatched blocks — see docstring).
+                if n_windows > depth:
+                    with telemetry.trace.span("window_wait", phase="step",
+                                              window=n_windows - 1 - depth):
+                        jax.block_until_ready(stats_list[n_windows - 1 - depth]["loss"])
+                    if steady_t0 is None:
+                        steady_t0 = time.perf_counter()
+                # Refill AFTER dispatching (first window included): the very
+                # first window's compute then hides the rest of the initial
+                # prefill's source latency — measured, not assumed, in
+                # tests/test_streaming_overlap.py.
+                while len(buf) < depth:
+                    block = pull()
+                    if block is None:
+                        break
+                    buf.append(block)
+        finally:
+            close = getattr(window_iter, "close", None)
+            if close is not None:
+                close()
         if not stats_list:
             raise ValueError("empty window iterator")
         self._report_stream_link(src_seconds, steady_src, steady_t0,

@@ -55,30 +55,48 @@ def _block_attention(q, k, v, carry, block_mask):
     return num, den, m_new
 
 
-def local_attention(q, k, v, causal: bool = False):
+def local_attention(q, k, v, causal: bool = False, segment_ids=None):
     """Reference (single-device) attention with the same layout
-    ([batch, seq, heads, dim]); used by tests and the non-sharded fallback."""
+    ([batch, seq, heads, dim]); used by tests and the non-sharded fallback.
+
+    ``segment_ids`` (``[batch, seq]`` ints, the sequence-packing convention
+    of :mod:`distkeras_tpu.datapipe.packing`) additionally restricts token
+    *i* to keys with the same segment id — each packed segment attends as
+    if it were alone in the row.  The diagonal is always in-segment
+    (``seg[i] == seg[i]``), so no softmax row is fully masked, pad rows
+    included.  With ``segment_ids=None`` the math (and the bits) are
+    unchanged."""
     qt = jnp.moveaxis(q, 1, 2)  # [b,h,l,d]
     kt = jnp.moveaxis(k, 1, 2)
     vt = jnp.moveaxis(v, 1, 2)
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
-    if causal:
+    if causal or segment_ids is not None:
         lq, lk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((lq, lk), bool))
+        mask = (jnp.tril(jnp.ones((lq, lk), bool)) if causal
+                else jnp.ones((lq, lk), bool))
+        if segment_ids is not None:
+            seg = jnp.asarray(segment_ids)
+            mask = mask & (seg[:, None, :, None] == seg[:, None, None, :])
         s = jnp.where(mask, s, -jnp.inf)
     out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vt)
     return jnp.moveaxis(out, 1, 2)
 
 
-def attention(q, k, v, causal: bool = False, use_flash: Optional[bool] = None):
+def attention(q, k, v, causal: bool = False, use_flash: Optional[bool] = None,
+              segment_ids=None):
     """Single-device attention dispatcher ([batch, seq, heads, dim]).
 
     On the TPU backend this routes to the fused Pallas flash kernel
     (:mod:`distkeras_tpu.ops.pallas`) — tiled online softmax, no [seq, seq]
     HBM materialisation; elsewhere (CPU test meshes) it uses the jnp
     reference path, which XLA:CPU handles better than the Pallas interpreter.
+
+    ``segment_ids`` (sequence packing) forces the reference path: the flash
+    kernel has no segment-mask tiling.
     """
+    if segment_ids is not None:
+        return local_attention(q, k, v, causal=causal, segment_ids=segment_ids)
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"
     if use_flash:

@@ -133,6 +133,8 @@ class Trainer:
         pipeline_stages: int = 1,
         pp_microbatches: Optional[int] = None,
         tp_spec_fn: Optional[Any] = None,
+        prefetch: int = 0,
+        checkpoint_blocks: int = 0,
     ):
         self.master_model = keras_model
         self.loss = loss
@@ -209,6 +211,30 @@ class Trainer:
                 "tp_spec_fn places leaves on the model mesh axis, which only "
                 "exists with tp_shards>1 (the GSPMD engine); without it the "
                 "override would be silently ignored"
+            )
+        # >0 with streaming=True: wrap the epoch's block iterator in a
+        # datapipe.PrefetchRing of this depth — gathers (and the h2d put)
+        # move to a producer thread and overlap device steps.  The block
+        # order and payloads are untouched, so the trajectory stays bitwise
+        # identical (tests/test_datapipe.py pins it).
+        self.prefetch = int(prefetch)
+        if self.prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {prefetch}")
+        # >0 with streaming + checkpoint_dir: additionally checkpoint every N
+        # consumed blocks MID-epoch (model state + datapipe.DataState cursor),
+        # so a killed run resumes at the block it died on, not the epoch
+        # boundary.  Needs the streaming path — the in-memory path dispatches
+        # whole epochs, leaving no block boundary to save at.
+        self.checkpoint_blocks = int(checkpoint_blocks)
+        if self.checkpoint_blocks < 0:
+            raise ValueError(
+                f"checkpoint_blocks must be >= 0, got {checkpoint_blocks}"
+            )
+        if self.checkpoint_blocks and not self.streaming:
+            raise ValueError(
+                "checkpoint_blocks>0 saves at streaming block boundaries; "
+                "set streaming=True (the in-memory path dispatches whole "
+                "epochs, so there is no mid-epoch point to save at)"
             )
         self.history: dict = {}
         self.training_time: float = 0.0
@@ -486,16 +512,38 @@ class Trainer:
             state = engine.init_state(
                 jax.random.PRNGKey(self.seed), feats[: self.batch_size]
             )
+        resume_data = None
         if resuming:
             state = self._restore_state(ckpt, engine, state, elastic, step=resume_step)
             start_epoch = int(np.asarray(state.epoch))
+            # data checkpoint sidecar (datapipe.DataState): exact RNG bit
+            # state + mid-epoch block cursor.  A sidecar whose epoch doesn't
+            # match the restored model epoch (external writer, older layout)
+            # is ignored — the legacy fast-forward below still aligns the
+            # shuffle stream at epoch granularity.
+            resume_data = ckpt.restore_data_state(resume_step)
+            if resume_data is not None and int(resume_data.epoch) != start_epoch:
+                resume_data = None
+            if (resume_data is not None and resume_data.block_cursor
+                    and not self.streaming):
+                raise ValueError(
+                    f"checkpoint at step {resume_step} was saved mid-epoch "
+                    f"(block cursor {resume_data.block_cursor}); resuming it "
+                    "requires streaming=True — the in-memory path dispatches "
+                    "whole epochs and cannot skip consumed blocks"
+                )
 
-        # keep the host RNG stream aligned with the epoch counter on resume
-        # (chunked dispatch shuffles on device, keyed by state.epoch — its
-        # alignment is free and the host stream is never drawn from)
+        # keep the host RNG stream aligned with the epoch counter on resume:
+        # exact bit-state restore when a DataState sidecar was saved, else
+        # the legacy epoch-granularity fast-forward.  (Chunked dispatch
+        # shuffles on device, keyed by state.epoch — its alignment is free
+        # and the host stream is never drawn from.)
         if self.dispatch_epochs == 1:
-            for _ in range(start_epoch):
-                rng.permutation(len(feats))
+            if resume_data is not None and resume_data.rng_state is not None:
+                resume_data.restore_rng(rng)
+            else:
+                for _ in range(start_epoch):
+                    rng.permutation(len(feats))
 
         scalar_log = None
         if self.tensorboard_dir:
@@ -581,15 +629,65 @@ class Trainer:
                     prof.on_step(epoch)
                 with telemetry.trace.span("epoch", epoch=epoch):
                     if self.streaming:
-                        from distkeras_tpu.data import epoch_window_iter
+                        from distkeras_tpu.data import epoch_window_iter, plan_epoch
 
+                        if window is not None:
+                            total_windows = plan_epoch(
+                                len(feats), num_workers, self.batch_size, window)[0]
+                        else:
+                            steps = plan_epoch(
+                                len(feats), num_workers, self.batch_size, 1)[0]
+                            total_windows = -(-steps // stream_window)
+                        start_block = 0
+                        if resume_data is not None and epoch == start_epoch:
+                            start_block = min(
+                                int(resume_data.block_cursor), total_windows)
+                        # bit state BEFORE this epoch's shuffle — what a
+                        # mid-epoch DataState must carry (the window iterator
+                        # is lazy: the shuffle is drawn at its first next())
+                        rng_bits = rng.bit_generator.state if shuffle else None
                         blocks = epoch_window_iter(
                             feats, labels, num_workers, self.batch_size, stream_window,
                             rng=rng if shuffle else None,
                             pad_to_window=window is not None,
                             feature_dtype=self.compute_dtype,
+                            start_block=start_block,
                         )
-                        run_one = lambda blocks=blocks: engine.run_epoch_streaming(state, blocks)
+                        if self.prefetch > 0:
+                            from distkeras_tpu.datapipe import PrefetchRing
+
+                            blocks = PrefetchRing(
+                                blocks, depth=self.prefetch,
+                                put_fn=engine.stream_put,
+                            )
+                        on_window = None
+                        if ckpt is not None and self.checkpoint_blocks:
+                            from distkeras_tpu.datapipe import DataState
+
+                            def on_window(live_state, done, _epoch=epoch,
+                                          _base=start_block, _bits=rng_bits,
+                                          _total=total_windows):
+                                # ``done`` windows consumed this run; the
+                                # live epoch counter reads _epoch + done
+                                # (run_epoch_streaming's end-of-epoch fixup
+                                # hasn't happened yet), so rewind it to the
+                                # epoch being trained.  Skip the final block
+                                # — the epoch-boundary save supersedes it.
+                                cursor = _base + done
+                                if done % self.checkpoint_blocks or cursor >= _total:
+                                    return
+                                ckpt.save_partial(
+                                    live_state.replace(
+                                        epoch=live_state.epoch - done),
+                                    _epoch,
+                                    DataState(epoch=_epoch, block_cursor=cursor,
+                                              rng_state=_bits),
+                                )
+
+                        run_one = (
+                            lambda blocks=blocks, on_window=on_window:
+                            engine.run_epoch_streaming(
+                                state, blocks, on_window=on_window))
                     else:
                         if window is None:
                             # single window spanning the whole epoch (no commits)
@@ -641,7 +739,17 @@ class Trainer:
                                 engine, ckpt, state, watchdog)
                             continue  # don't checkpoint the diverged state
                     if ckpt is not None:
-                        ckpt.maybe_save(state, epoch)
+                        # epoch-boundary DataState: cursor 0 at the next
+                        # epoch, RNG bits as they stand now (= before the
+                        # next epoch's shuffle) — resume restores the exact
+                        # bit state instead of replaying permutations
+                        from distkeras_tpu.datapipe import DataState
+
+                        ckpt.maybe_save(state, epoch, data_state=DataState(
+                            epoch=epoch + 1, block_cursor=0,
+                            rng_state=(rng.bit_generator.state
+                                       if shuffle else None),
+                        ))
             if epoch_stats and not isinstance(
                     jax.tree.leaves(epoch_stats[-1])[0], np.ndarray):
                 epoch_stats[-1] = _materialise(epoch_stats[-1], self.num_epoch - 1)
@@ -837,8 +945,29 @@ class EnsembleTrainer(Trainer):
         engine, state, adapter = self._fit(
             dataframe, worker.rule, self.num_models, shuffle=shuffle
         )
-        model_state = jax.tree.map(np.asarray, engine.final_model_state(state))
         adapter = _serving_twin(adapter)
+        if hasattr(adapter, "assign"):
+            # Keras in -> Keras models out (reference parity: the reference's
+            # EnsembleTrainer returned N deserialised Keras models).  One
+            # independent clone per ensemble member, each carrying its own
+            # worker's weights — adapter.assign would mutate the single
+            # shared wrapped model N times, leaving N handles to the last
+            # worker's weights.
+            import keras
+
+            from distkeras_tpu.models.keras_adapter import assign_keras_weights
+
+            models = []
+            for i in range(self.num_models):
+                params_i = engine.worker_slice(state.local_params, i)
+                state_i = engine.worker_slice(state.model_state, i)
+                clone = keras.models.clone_model(adapter.model)
+                if not clone.built:
+                    clone.build(adapter.model.input_shape)
+                assign_keras_weights(clone, params_i, state_i.get("ntv"))
+                models.append(clone)
+            return models
+        model_state = jax.tree.map(np.asarray, engine.final_model_state(state))
         return [
             TrainedModel(adapter, engine.worker_slice(state.local_params, i),
                          model_state, history=self.history)
@@ -886,6 +1015,8 @@ class DistributedTrainer(Trainer):
         pipeline_stages: int = 1,
         pp_microbatches: Optional[int] = None,
         tp_spec_fn: Optional[Any] = None,
+        prefetch: int = 0,
+        checkpoint_blocks: int = 0,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
@@ -893,6 +1024,7 @@ class DistributedTrainer(Trainer):
             checkpoint_dir, checkpoint_every, resume, profile_dir, seq_shards,
             tp_shards, fsdp, tensorboard_dir, streaming, remat, unroll,
             dispatch_epochs, pipeline_stages, pp_microbatches, tp_spec_fn,
+            prefetch, checkpoint_blocks,
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port

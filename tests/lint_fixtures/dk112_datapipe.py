@@ -1,0 +1,68 @@
+"""DK112 fixture — the prefetch-ring hot region (``_produce`` of ``*Ring``).
+
+Mirrors the shape of ``distkeras_tpu.datapipe.ring.PrefetchRing``: bounded
+queue waits are the sanctioned idiom and stay clean, while genuine blocking
+calls — and, only in this closure, host-sync pulls (``.item()`` /
+``.tolist()``) — fire.  Not package-scoped, so the deliberate violations
+below also surface in the self-lint run; each carries a
+selflint_baseline.json entry.  Keep edits append-only or update the test.
+"""
+import queue
+import threading
+import time
+
+_TICK = 0.05
+
+
+class ToyPrefetchRing:
+    def __init__(self, it, depth=2):
+        self._it = it
+        self._q = queue.Queue(maxsize=depth)
+        self._closed = threading.Event()
+
+    def _offer(self, item):
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=_TICK)    # bounded put: clean
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self):
+        while not self._closed.is_set():
+            block = self._gather()
+            if block is None:
+                break
+            self._offer(block)
+
+    def _gather(self):
+        xs, ys = next(self._it, (None, None))
+        if xs is None:
+            return None
+        n = xs.sum().item()             # line 43: DK112 (.item() in gather path)
+        sizes = ys.tolist()             # line 44: DK112 (.tolist() in gather path)
+        time.sleep(0.01)                # line 45: DK112 (sleep throttles the ring)
+        return xs, ys, n, sizes
+
+
+class PatientRing:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=2)
+        self._closed = threading.Event()
+
+    def _produce(self):
+        while not self._closed.is_set():
+            try:
+                item = self._q.get(timeout=_TICK)   # bounded get: clean
+            except queue.Empty:
+                continue
+            if item is None:
+                break
+
+
+def cold_consumer(blocks):
+    total = 0.0
+    for xs, _ in blocks:
+        total += xs.sum().item()        # not ring-hot: clean
+    return total

@@ -14,13 +14,116 @@ ZeRO-3-sharded over a workers axis spanning both processes.
 import sys
 
 
+def _elastic(mode: str, process_id: int, num_processes: int,
+             ckpt_dir: str) -> None:
+    """Datapipe elastic-resume rehearsal (two phases, separate invocations).
+
+    ``elastic_save`` (2 processes): full trainer flow — streaming +
+    PrefetchRing + mid-epoch block checkpoints — killed by a simulated
+    preemption at block 3 of epoch 1, leaving a partial step with a
+    DataState cursor on the shared checkpoint dir.  ``elastic_resume``
+    (4 processes): a fresh trainer at a DIFFERENT host topology (same
+    8-device global mesh) restores model + DataState, replays the epoch's
+    shuffle, skips the consumed blocks, and trains to completion.
+    """
+    import numpy as np
+
+    import distkeras_tpu as dk
+    from distkeras_tpu import checkpoint as ck
+    from distkeras_tpu.datapipe import host_shard
+    from distkeras_tpu.frame import from_numpy
+    from distkeras_tpu.models import MLP, FlaxModel
+
+    # the per-host sharding helper under a REAL multi-process runtime:
+    # defaults pick up jax.process_index(), ranges partition the rows
+    spans = [host_shard(512, i, num_processes) for i in range(num_processes)]
+    assert host_shard(512) == spans[process_id]
+    assert spans[0][0] == 0 and spans[-1][1] == 512
+    assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+
+    rng = np.random.default_rng(0)  # same data on every process (SPMD)
+    x = rng.normal(size=(512, 8)).astype(np.float32)
+    y = (x @ rng.normal(size=(8,)) > 0).astype(np.int32)
+    onehot = np.eye(2, dtype=np.float32)[y]
+    df = from_numpy(x, onehot)
+
+    def trainer(resume):
+        return dk.DOWNPOUR(
+            FlaxModel(MLP(features=(16,), num_classes=2)),
+            loss="categorical_crossentropy",
+            worker_optimizer=("sgd", {"learning_rate": 0.1}),
+            num_workers=8, batch_size=8, num_epoch=3,
+            communication_window=2, seed=3, streaming=True, prefetch=2,
+            checkpoint_dir=ckpt_dir, checkpoint_blocks=2, resume=resume,
+        )
+
+    if mode == "elastic_save":
+        # 4 blocks/epoch; die at block 3 of epoch 1 — after the cursor-2
+        # partial save, before the boundary save
+        import distkeras_tpu.data as data_mod
+
+        orig_iter = data_mod.epoch_window_iter
+        calls = {"n": 0}
+
+        def killing_iter(*a, **kw):
+            calls["n"] += 1
+            inner = orig_iter(*a, **kw)
+            if calls["n"] == 2:
+                def gen():
+                    for i, blk in enumerate(inner):
+                        if i == 3:
+                            raise RuntimeError("simulated preemption")
+                        yield blk
+                return gen()
+            return inner
+
+        data_mod.epoch_window_iter = killing_iter
+        died = False
+        try:
+            trainer(resume=False).train(df, shuffle=True)
+        except RuntimeError as e:
+            assert "preemption" in str(e)
+            died = True
+        assert died, "simulated preemption did not fire"
+        data_mod.epoch_window_iter = orig_iter
+        ck.wait_until_finished()  # commit the in-flight partial before exit
+        ds = ck.restore_data_state(ckpt_dir)
+        assert ds is not None and (ds.epoch, ds.block_cursor) == (1, 2), ds
+    else:
+        ds = ck.restore_data_state(ckpt_dir)
+        assert ds is not None and (ds.epoch, ds.block_cursor) == (1, 2), ds
+        t = trainer(resume=True)
+        trained = t.train(df, shuffle=True)
+        # resumed inside epoch 1: only epochs 1 and 2 ran here
+        assert len(t.get_history()["loss"]) == 2, t.get_history()
+        assert ck.latest_step(ckpt_dir) == 3
+        # boundary saves supersede the mid-epoch cursor: the final sidecar
+        # is a cursor-0 one carrying the next epoch's RNG bits
+        final = ck.restore_data_state(ckpt_dir)
+        assert final is None or int(final.block_cursor) == 0, final
+        preds = np.argmax(np.asarray(trained.predict(x)), -1)
+        acc = float((preds == y).mean())
+        assert acc > 0.8, acc
+
+
 def main(coordinator: str, num_processes: int, process_id: int,
-         engine_kind: str = "windowed") -> None:
-    import jax
+         engine_kind: str = "windowed", ckpt_dir: str = "") -> None:
+    import os
 
     devices_per_proc = 8 // num_processes
+    # set before the backend initialises; jax_num_cpu_devices is the
+    # modern spelling, XLA_FLAGS the fallback for older installs
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    import jax
+
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", devices_per_proc)
+    try:
+        jax.config.update("jax_num_cpu_devices", devices_per_proc)
+    except AttributeError:
+        pass  # XLA_FLAGS above already forces the device count
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
@@ -28,6 +131,12 @@ def main(coordinator: str, num_processes: int, process_id: int,
     )
     assert jax.device_count() == 8, jax.device_count()
     assert jax.local_device_count() == devices_per_proc
+
+    if engine_kind in ("elastic_save", "elastic_resume"):
+        _elastic(engine_kind, process_id, num_processes, ckpt_dir)
+        print(f"process {process_id}: ok ({engine_kind})")
+        jax.distributed.shutdown()
+        return
 
     import numpy as np
 
@@ -153,4 +262,5 @@ def main(coordinator: str, num_processes: int, process_id: int,
 
 if __name__ == "__main__":
     main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
-         sys.argv[4] if len(sys.argv) > 4 else "windowed")
+         sys.argv[4] if len(sys.argv) > 4 else "windowed",
+         sys.argv[5] if len(sys.argv) > 5 else "")

@@ -313,6 +313,31 @@ def test_dk112_cold_and_timed_calls_are_silent():
     assert 64 not in lines  # dict.get(key) is not queue.get()
 
 
+def test_dk112_prefetch_ring_fixture():
+    got, _ = _run("dk112_datapipe.py", ["DK112"])
+    assert got == [
+        ("DK112", 43),  # .item() in the gather path (ring-hot only)
+        ("DK112", 44),  # .tolist() in the gather path (ring-hot only)
+        ("DK112", 45),  # time.sleep throttling the producer
+    ]
+
+
+def test_dk112_ring_queue_waits_are_silent():
+    got, _ = _run("dk112_datapipe.py", ["DK112"])
+    lines = [ln for _, ln in got]
+    assert 26 not in lines  # q.put(timeout=_TICK) bounded offer
+    assert 57 not in lines  # q.get(timeout=_TICK) bounded pull
+    assert 66 not in lines  # .item() outside the ring closure: clean
+
+
+def test_dk112_package_ring_is_clean():
+    """The shipped PrefetchRing must satisfy its own rule: bounded waits
+    everywhere, no host sync in the producer."""
+    path = os.path.join(REPO_ROOT, "distkeras_tpu", "datapipe", "ring.py")
+    findings, _ = analyze([path], root=REPO_ROOT, select=["DK112"])
+    assert [(f.rule, f.line) for f in findings] == []
+
+
 def test_dk113_daemon_protocol_fixture(tmp_path):
     assert _run_in_package(
         tmp_path, "dk113_daemon_protocol.py", ["DK113"]

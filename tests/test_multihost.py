@@ -24,7 +24,8 @@ def _free_port() -> int:
     return port
 
 
-def _run_processes(num_processes: int, engine_kind: str, timeout: int = 300):
+def _run_processes(num_processes: int, engine_kind: str, timeout: int = 300,
+                   extra: tuple = ()):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = os.path.join(repo, "tests", "multihost_worker.py")
     coordinator = f"127.0.0.1:{_free_port()}"
@@ -32,7 +33,7 @@ def _run_processes(num_processes: int, engine_kind: str, timeout: int = 300):
     procs = [
         subprocess.Popen(
             [sys.executable, script, coordinator, str(num_processes), str(i),
-             engine_kind],
+             engine_kind, *extra],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
         )
         for i in range(num_processes)
@@ -79,6 +80,18 @@ def test_two_process_pipeline_parallel():
     # the stages axis spans processes: ppermute activation hops and the
     # stage-sharded block params both cross the process boundary
     _run_processes(2, "pipeline")
+
+
+@pytest.mark.slow
+def test_elastic_mid_epoch_resume_across_process_counts(tmp_path):
+    # datapipe elastic rehearsal: a 2-process streaming run (PrefetchRing +
+    # mid-epoch block checkpoints) dies to a simulated preemption at block 3
+    # of epoch 1; a 4-process run — same 8-device global mesh, different
+    # host topology — restores model + DataState from the shared directory,
+    # skips the consumed blocks, and trains to completion
+    d = str(tmp_path / "ckpt")
+    _run_processes(2, "elastic_save", timeout=420, extra=(d,))
+    _run_processes(4, "elastic_resume", timeout=420, extra=(d,))
 
 
 @pytest.mark.slow

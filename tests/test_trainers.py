@@ -89,6 +89,35 @@ def test_ensemble_trainer_returns_n_models(toy_classification):
     assert not np.allclose(p0, p1)
 
 
+def test_ensemble_trainer_keras_returns_n_keras_models(toy_classification):
+    """Reference parity: a Keras model in means N trained Keras models out
+    (the reference's EnsembleTrainer returned deserialised Keras models).
+    Each member must be an independent clone carrying ITS worker's weights —
+    not N handles onto one mutated model."""
+    keras = pytest.importorskip("keras")
+
+    x, y, onehot = toy_classification
+    km = keras.Sequential([
+        keras.layers.Input(shape=(8,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    t = dk.EnsembleTrainer(km, loss="categorical_crossentropy",
+                           worker_optimizer=("sgd", {"learning_rate": 0.1}),
+                           num_models=3, batch_size=16, num_epoch=6)
+    models = t.train(from_numpy(x, onehot))
+    assert len(models) == 3
+    assert all(isinstance(m, keras.Model) for m in models)
+    assert all(m is not km for m in models)
+    for m in models:
+        preds = np.asarray(m.predict(x, verbose=0))
+        assert float(np.mean(np.argmax(preds, -1) == y)) > 0.7
+    # independent members: first kernel differs between clones
+    w0 = models[0].get_weights()[0]
+    w1 = models[1].get_weights()[0]
+    assert not np.allclose(w0, w1)
+
+
 def test_parameter_server_pollable_mid_train(toy_classification):
     """Reference parity: the socket PS answered ``num_updates`` queries
     WHILE training ran.  The facade must do the same — epoch boundaries

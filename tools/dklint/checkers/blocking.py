@@ -38,6 +38,7 @@ from tools.dklint.checkers.host_sync import (
 )
 
 HOT112_KEY = "DK112.hot"
+RING112_KEY = "DK112.ring_hot"
 
 # socket-object methods (attribute calls) that block on the network
 SOCKET_METHODS = frozenset({
@@ -78,14 +79,47 @@ def _serving_loop_seeds(project: Project) -> Set[int]:
     return seeds
 
 
+def _prefetch_ring_seeds(project: Project) -> Set[int]:
+    """``_produce`` methods of ``*Ring`` classes — the datapipe prefetch
+    worker loop.  Hot for throughput reasons: a block in the producer
+    starves the ring and every device step behind it."""
+    seeds: Set[int] = set()
+    for facts in project.data.get(FACTS_KEY, {}).values():
+        index = facts["index"]
+        for fn in index.fns:
+            if (
+                id(fn) in getattr(index, "in_ring_class", set())
+                and getattr(fn, "name", "") == "_produce"
+            ):
+                seeds.add(id(fn))
+    return seeds
+
+
 def hot_regions(project: Project) -> Set[int]:
-    """DK101's global hot closure plus the serving loop closure (memoized)."""
+    """DK101's global hot closure plus the serving-loop and prefetch-ring
+    closures (memoized)."""
     cached = project.data.get(HOT112_KEY)
     if cached is not None:
         return cached
-    seeds = set(global_hot_functions(project)) | _serving_loop_seeds(project)
+    seeds = (set(global_hot_functions(project)) | _serving_loop_seeds(project)
+             | _prefetch_ring_seeds(project))
     hot = propagate_hot(project, seeds)
     project.data[HOT112_KEY] = hot
+    return hot
+
+
+def ring_hot_regions(project: Project) -> Set[int]:
+    """The prefetch-ring closure alone: functions where host-sync pulls
+    (``.item()`` / ``.tolist()``) are ADDITIONALLY flagged — in the gather
+    path they serialise the producer against the device stream, defeating
+    the overlap the ring exists to provide.  Kept separate from the serving
+    closure so decode loops (which legitimately read scalars between
+    dispatches) do not churn."""
+    cached = project.data.get(RING112_KEY)
+    if cached is not None:
+        return cached
+    hot = propagate_hot(project, _prefetch_ring_seeds(project))
+    project.data[RING112_KEY] = hot
     return hot
 
 
@@ -108,14 +142,16 @@ class BlockingCallChecker(Checker):
 
     def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
         hot = hot_regions(project)
+        ring_hot = ring_hot_regions(project)
         for fn in ast.walk(fi.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
                 continue
             if id(fn) not in hot:
                 continue
-            yield from self._check_body(fi, fn)
+            yield from self._check_body(fi, fn, ring=id(fn) in ring_hot)
 
-    def _check_body(self, fi: FileInfo, fn: ast.AST) -> Iterable[Finding]:
+    def _check_body(self, fi: FileInfo, fn: ast.AST,
+                    ring: bool = False) -> Iterable[Finding]:
         nested: Set[int] = set()
         for child in ast.walk(fn):
             if child is not fn and isinstance(
@@ -125,7 +161,7 @@ class BlockingCallChecker(Checker):
         for node in ast.walk(fn):
             if id(node) in nested or not isinstance(node, ast.Call):
                 continue
-            why = self._blocking_reason(node, fi)
+            why = self._blocking_reason(node, fi, ring=ring)
             if why is not None:
                 yield Finding(
                     path=fi.relpath,
@@ -135,7 +171,8 @@ class BlockingCallChecker(Checker):
                     message=f"blocking call in hot region: {why}",
                 )
 
-    def _blocking_reason(self, node: ast.Call, fi: FileInfo) -> Optional[str]:
+    def _blocking_reason(self, node: ast.Call, fi: FileInfo,
+                         ring: bool = False) -> Optional[str]:
         name = call_name(node) or ""
         head, _, rest = name.partition(".")
         resolved = fi.imports.get(head)
@@ -153,6 +190,11 @@ class BlockingCallChecker(Checker):
         attr = node.func.attr
         if attr in SOCKET_METHODS:
             return f".{attr}() blocks on the network"
+        if ring and attr in ("item", "tolist"):
+            return (
+                f"host sync .{attr}() in the prefetch gather path serialises "
+                "the producer against the device stream"
+            )
         if attr == "acquire":
             if _has_kwarg(node, "timeout") or _nonblocking_flag(node):
                 return None

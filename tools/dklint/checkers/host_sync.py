@@ -115,6 +115,7 @@ class _FnIndex(ast.NodeVisitor):
         self.by_name: Dict[str, List[ast.AST]] = {}
         self.fns: List[ast.AST] = []
         self.in_engine_class: Set[int] = set()
+        self.in_ring_class: Set[int] = set()
         self._stack: List[ast.AST] = []
         self._class_stack: List[str] = []
 
@@ -124,6 +125,8 @@ class _FnIndex(ast.NodeVisitor):
         self.by_name.setdefault(name, []).append(node)
         if self._class_stack and self._class_stack[-1].endswith("Engine"):
             self.in_engine_class.add(id(node))
+        if self._class_stack and self._class_stack[-1].endswith("Ring"):
+            self.in_ring_class.add(id(node))
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
         self._class_stack.append(node.name)
