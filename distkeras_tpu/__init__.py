@@ -14,7 +14,7 @@ follows.
 
 __version__ = "0.1.0"
 
-from distkeras_tpu import frame, sanitizer, utils
+from distkeras_tpu import chaos, fleet, frame, sanitizer, utils
 from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator, PerplexityEvaluator
 from distkeras_tpu.frame import (
     DataFrame,
@@ -31,6 +31,7 @@ from distkeras_tpu.trainers import (
     ADAG,
     AEASGD,
     DOWNPOUR,
+    AdaptiveDynSGD,
     AsynchronousDistributedTrainer,
     AveragingTrainer,
     DistributedTrainer,
@@ -69,6 +70,7 @@ __all__ = [
     "EAMSGD",
     "ADAG",
     "DynSGD",
+    "AdaptiveDynSGD",
     "ModelPredictor",
     "AccuracyEvaluator",
     "LossEvaluator",

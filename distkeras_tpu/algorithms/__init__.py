@@ -2,6 +2,7 @@
 worker/parameter-server algorithm pairs (SURVEY.md §2, §3.3)."""
 
 from distkeras_tpu.algorithms.adag import Adag
+from distkeras_tpu.algorithms.adaptive import AdaptiveBound, AdaptiveDynSGD
 from distkeras_tpu.algorithms.aeasgd import Aeasgd, Eamsgd
 from distkeras_tpu.algorithms.base import CommitCtx, CommitResult, UpdateRule, make_ctx
 from distkeras_tpu.algorithms.downpour import Downpour
@@ -18,6 +19,8 @@ __all__ = [
     "Aeasgd",
     "Eamsgd",
     "DynSGD",
+    "AdaptiveDynSGD",
+    "AdaptiveBound",
     "Sequential",
     "OneShotAverage",
 ]

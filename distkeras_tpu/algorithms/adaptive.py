@@ -1,0 +1,151 @@
+"""Adaptive-staleness DynSGD — ABS/DynSSP-style online bound control.
+
+Two cooperating halves:
+
+* :class:`AdaptiveDynSGD` — a DynSGD variant whose center state carries a
+  ``staleness_bound`` scalar **as traced data** (a float32 leaf, so the
+  host can move it between epochs without retracing).  A commit whose
+  staleness exceeds the bound is *dropped* — its delta never reaches the
+  center — but the worker still pulls the fresh center and re-anchors,
+  i.e. a straggler degrades into a catch-up pull instead of poisoning the
+  center with ancient gradients (SSP-style bounded staleness, per DynSSP
+  arXiv:1908.11848).  With the bound at its ``inf`` default the rule is
+  bit-for-bit DynSGD.
+
+* :class:`AdaptiveBound` — the host-side policy (ABS arXiv:2301.08895
+  style): between epochs it reads the dynamics summary the telemetry layer
+  already computes (``divergence_max``, ``rule_staleness_max``) and
+  tightens the bound multiplicatively when divergence spikes against its
+  running median, loosens it gently while training is stable.  Trainers
+  apply the returned bound by replacing the ``staleness_bound`` leaf.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.algorithms.base import CommitCtx, CommitResult
+from distkeras_tpu.algorithms.dynsgd import DynSGD
+from distkeras_tpu.utils.pytree import tree_add, tree_where
+
+__all__ = ["AdaptiveBound", "AdaptiveDynSGD"]
+
+BOUND_KEY = "staleness_bound"
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptiveDynSGD(DynSGD):
+    communication_window: int = 5
+    #: initial staleness bound; ``inf`` = plain DynSGD until a policy tightens it
+    initial_bound: float = float("inf")
+
+    def init_center_state(self):
+        state = super().init_center_state()
+        state[BOUND_KEY] = jnp.asarray(self.initial_bound, jnp.float32)
+        return state
+
+    def dynamics(self, ctx: CommitCtx, local_params, center_params,
+                 local_state, center_state):
+        out = super().dynamics(ctx, local_params, center_params,
+                               local_state, center_state)
+        staleness = out["rule_staleness"]
+        bound = center_state[BOUND_KEY]
+        out["rule_bound"] = jnp.broadcast_to(bound, staleness.shape)
+        out["rule_dropped"] = (staleness > bound).astype(jnp.float32)
+        return out
+
+    def commit(self, ctx: CommitCtx, local_params, center_params,
+               local_state, center_state):
+        num_updates = center_state["num_updates"]
+        staleness = (num_updates - local_state["clock"]).astype(jnp.float32)
+        # the SSP gate: over-bound commits contribute nothing to the center
+        # (and don't count as updates), but the worker still re-anchors below
+        commit_mask = ctx.mask & (staleness <= center_state[BOUND_KEY])
+        scale = 1.0 / (staleness + 1.0)
+        delta = jax.tree.map(
+            lambda x, a: (x - a) * scale, local_params, local_state["anchor"]
+        )
+        gated = CommitCtx(ctx.psum, commit_mask, ctx.steps_in_window,
+                          ctx.num_workers)
+        summed = ctx.psum(self._masked(gated, delta))
+        new_center = tree_add(center_params, summed)
+        new_num_updates = num_updates + self._count_commits(gated)
+        # pull/re-anchor on the ORIGINAL boundary mask: a dropped (too-stale)
+        # worker adopts the fresh center and resets its clock — graceful
+        # catch-up instead of blocking the window
+        new_local = self._pull(ctx, new_center, local_params)
+        new_state = {
+            "anchor": tree_where(ctx.mask, new_center, local_state["anchor"]),
+            "clock": jnp.where(ctx.mask, new_num_updates, local_state["clock"]),
+        }
+        return CommitResult(new_local, new_center, new_state,
+                            {"num_updates": new_num_updates,
+                             BOUND_KEY: center_state[BOUND_KEY]})
+
+
+class AdaptiveBound:
+    """Host-side bound controller, applied between epochs.
+
+    ``observe(summary)`` consumes one epoch's dynamics summary
+    (:func:`distkeras_tpu.telemetry.dynamics.summarize` keys) and returns
+    the bound the next epoch should run under:
+
+    * divergence above ``divergence_factor`` x its running median →
+      **tighten** (``bound *= tighten``, floored at ``min_bound``) — stale
+      commits are hurting, gate them harder;
+    * stable divergence → **loosen** (``bound *= loosen``, capped at
+      ``max_bound``) — admit more asynchrony while it is safe.
+
+    The bound also never tightens below the observed median staleness + 1:
+    a bound under what healthy workers actually exhibit would starve the
+    center entirely.
+    """
+
+    def __init__(self, initial: float = 16.0, min_bound: float = 1.0,
+                 max_bound: float = 256.0, tighten: float = 0.5,
+                 loosen: float = 1.25, divergence_factor: float = 2.0,
+                 history: int = 8):
+        self.bound = float(initial)
+        self.min_bound = float(min_bound)
+        self.max_bound = float(max_bound)
+        self.tighten = float(tighten)
+        self.loosen = float(loosen)
+        self.divergence_factor = float(divergence_factor)
+        self._divergences: deque = deque(maxlen=int(history))
+        self.tightened = 0
+        self.loosened = 0
+
+    @staticmethod
+    def _median(values) -> Optional[float]:
+        if not values:
+            return None
+        ordered = sorted(values)
+        mid = len(ordered) // 2
+        if len(ordered) % 2:
+            return ordered[mid]
+        return 0.5 * (ordered[mid - 1] + ordered[mid])
+
+    def observe(self, summary: dict) -> float:
+        div = summary.get("divergence_max")
+        staleness = summary.get("rule_staleness_mean",
+                                summary.get("rule_staleness"))
+        baseline = self._median(list(self._divergences))
+        if div is not None:
+            self._divergences.append(float(div))
+        if (div is not None and baseline is not None and baseline > 0
+                and float(div) > self.divergence_factor * baseline):
+            self.bound = max(self.min_bound, self.bound * self.tighten)
+            self.tightened += 1
+        else:
+            self.bound = min(self.max_bound, self.bound * self.loosen)
+            self.loosened += 1
+        if staleness is not None:
+            # never gate below what live workers actually exhibit
+            self.bound = max(self.bound, float(staleness) + 1.0)
+        self.bound = min(self.bound, self.max_bound)
+        return self.bound

@@ -1,0 +1,255 @@
+"""Deterministic chaos harness — fault injection for the fleet layer.
+
+Opt-in through ``DISTKERAS_CHAOS=<seed>:<spec>`` (same discipline as
+``DISTKERAS_SANITIZE``): unset/falsey ⇒ **off** — every hook is one cached
+bool check, the control-plane objects are stock, and the lowered training
+program is byte-identical (pinned by test).  The harness never touches
+jitted code: every fault fires on the host, at a named *site*, from seeded
+per-site counters — so a chaos run is exactly reproducible, which is what
+makes recovery paths provable in CI rather than asserted.
+
+``<spec>`` is a comma-separated ``key=value`` list:
+
+======================  =====================================================
+``kill_epoch=N``        raise :class:`ChaosKilled` entering epoch number N
+                        (0-based count of ``epoch`` faults; fires once)
+``kill_block=N``        raise :class:`ChaosKilled` at the Nth streaming block
+                        (global across epochs; fires once)
+``stall_block=N``       sleep ``stall_secs`` at the Nth block (fires once)
+``stall_secs=S``        stall duration for ``stall_block`` (default 0.05)
+``refuse_connect=K``    first K ``connect`` sites raise ConnectionRefusedError
+``drop_reply=K``        first K ``rpc_reply`` sites raise ConnectionError —
+                        the request reached the daemon, the reply was lost
+``drop_recv=K``         first K ``recv`` sites raise ConnectionError
+``tear_send=K``         first K ``send`` sites put a truncated frame on the
+                        wire (seeded split point) then raise ConnectionError
+``delay_send_ms=M``     every ``send`` site sleeps M milliseconds first
+======================  =====================================================
+
+Example: ``DISTKERAS_CHAOS=7:kill_block=5,refuse_connect=2``.
+
+Tests flip the switch with :func:`configure` instead of mutating
+``os.environ``, exactly like ``sanitizer.configure``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, Iterable, Iterator, Optional
+
+__all__ = [
+    "ChaosConfig",
+    "ChaosKilled",
+    "configure",
+    "counts",
+    "enabled",
+    "fault",
+    "spec",
+    "tear_bytes",
+    "wrap_blocks",
+]
+
+_FALSEY = ("", "0", "false", "no")
+
+# integer-valued spec keys and their meaning; anything else is rejected so a
+# typo'd fault name fails loudly instead of silently injecting nothing
+_INT_KEYS = frozenset({
+    "kill_epoch", "kill_block", "stall_block", "refuse_connect",
+    "drop_reply", "drop_recv", "tear_send", "delay_send_ms",
+})
+_FLOAT_KEYS = frozenset({"stall_secs"})
+
+
+class ChaosKilled(RuntimeError):
+    """A seeded worker-kill fault fired (the injected analogue of a
+    preempted/crashed worker mid-run)."""
+
+
+class ChaosConfig:
+    """Parsed ``<seed>:<spec>``; ``None`` spec values mean 'not armed'."""
+
+    def __init__(self, seed: int, params: Dict[str, float]):
+        self.seed = int(seed)
+        self.params = dict(params)
+
+    def get(self, key: str) -> Optional[float]:
+        return self.params.get(key)
+
+    @classmethod
+    def parse(cls, raw: str) -> "ChaosConfig":
+        head, _, rest = raw.partition(":")
+        try:
+            seed = int(head)
+        except ValueError as e:
+            raise ValueError(
+                f"DISTKERAS_CHAOS must start with '<seed>:', got {raw!r}"
+            ) from e
+        params: Dict[str, float] = {}
+        for item in filter(None, (p.strip() for p in rest.split(","))):
+            key, sep, value = item.partition("=")
+            if not sep:
+                raise ValueError(f"chaos spec item {item!r} is not key=value")
+            if key in _INT_KEYS:
+                params[key] = int(value)
+            elif key in _FLOAT_KEYS:
+                params[key] = float(value)
+            else:
+                raise ValueError(
+                    f"unknown chaos spec key {key!r} (known: "
+                    f"{sorted(_INT_KEYS | _FLOAT_KEYS)})"
+                )
+        return cls(seed, params)
+
+
+# None = not yet resolved from the environment; False = resolved off;
+# a ChaosConfig once resolved on (or forced via configure()).
+_CONFIG = None
+_LOCK = threading.Lock()
+_COUNTS: Dict[str, int] = {}
+_FIRED: set = set()
+
+
+def _resolve():
+    global _CONFIG
+    if _CONFIG is None:
+        raw = os.environ.get("DISTKERAS_CHAOS", "")
+        _CONFIG = ChaosConfig.parse(raw) if raw.lower() not in _FALSEY else False
+    return _CONFIG
+
+
+def enabled() -> bool:
+    """Whether chaos injection is armed; cached after the first read."""
+    return _resolve() is not False
+
+
+def spec() -> Optional[ChaosConfig]:
+    cfg = _resolve()
+    return cfg if cfg is not False else None
+
+
+def configure(raw: Optional[str] = None) -> None:
+    """Force the spec (``"<seed>:<spec>"``), disable (``""``), or reset to
+    env-driven (``None``).  Clears every site counter and fired-fault
+    record, so each test starts from a clean chaos timeline."""
+    global _CONFIG
+    with _LOCK:
+        if raw is None:
+            _CONFIG = None
+        elif raw.lower() in _FALSEY:
+            _CONFIG = False
+        else:
+            _CONFIG = ChaosConfig.parse(raw)
+        _COUNTS.clear()
+        _FIRED.clear()
+
+
+def counts() -> Dict[str, int]:
+    """Per-site fault-hook hit counts (introspection for tests)."""
+    with _LOCK:
+        return dict(_COUNTS)
+
+
+def _next_count(site: str) -> int:
+    """Increment and return the 0-based hit index for ``site``."""
+    with _LOCK:
+        n = _COUNTS.get(site, 0)
+        _COUNTS[site] = n + 1
+        return n
+
+
+def _fire_once(key: str) -> bool:
+    with _LOCK:
+        if key in _FIRED:
+            return False
+        _FIRED.add(key)
+        return True
+
+
+def _note(kind: str) -> None:
+    # chaos decisions are visible in the telemetry registry so a CI chaos
+    # leg can assert faults actually fired; one cached-bool check when off
+    from distkeras_tpu import telemetry
+
+    if telemetry.enabled():
+        telemetry.metrics.counter(
+            f"chaos_{kind}_total", help=f"chaos faults injected ({kind})"
+        ).inc()
+
+
+def fault(site: str) -> None:
+    """Fire any armed fault for ``site``; no-op (beyond one counter bump)
+    otherwise.  Sites: ``connect``, ``send``, ``recv``, ``rpc_reply``,
+    ``epoch``, ``block``."""
+    cfg = spec()
+    if cfg is None:
+        return
+    n = _next_count(site)
+    if site == "connect":
+        k = cfg.get("refuse_connect")
+        if k is not None and n < k:
+            _note("refuse_connect")
+            raise ConnectionRefusedError(
+                f"chaos: connect refused ({n + 1}/{int(k)})")
+    elif site == "rpc_reply":
+        k = cfg.get("drop_reply")
+        if k is not None and n < k:
+            _note("drop_reply")
+            raise ConnectionError(f"chaos: reply dropped ({n + 1}/{int(k)})")
+    elif site == "recv":
+        k = cfg.get("drop_recv")
+        if k is not None and n < k:
+            _note("drop_recv")
+            raise ConnectionError(f"chaos: recv dropped ({n + 1}/{int(k)})")
+    elif site == "send":
+        delay = cfg.get("delay_send_ms")
+        if delay:
+            _note("delay_send")
+            time.sleep(delay / 1000.0)
+    elif site == "epoch":
+        k = cfg.get("kill_epoch")
+        if k is not None and n == k and _fire_once("kill_epoch"):
+            _note("kill_epoch")
+            raise ChaosKilled(f"chaos: worker killed entering epoch {n}")
+    elif site == "block":
+        k = cfg.get("kill_block")
+        if k is not None and n == k and _fire_once("kill_block"):
+            _note("kill_block")
+            raise ChaosKilled(f"chaos: worker killed at block {n}")
+        k = cfg.get("stall_block")
+        if k is not None and n == k and _fire_once("stall_block"):
+            _note("stall_block")
+            time.sleep(cfg.get("stall_secs") or 0.05)
+
+
+def tear_bytes(site: str, frame_len: int) -> Optional[int]:
+    """When a ``tear_send`` fault is armed for this hit of ``site``, the
+    number of leading frame bytes to put on the wire before dropping the
+    connection (seeded split point, always a proper prefix); ``None``
+    otherwise.  Does NOT consume the site counter — call before
+    :func:`fault` for the same frame."""
+    cfg = spec()
+    if cfg is None:
+        return None
+    k = cfg.get("tear_send")
+    if k is None:
+        return None
+    with _LOCK:
+        n = _COUNTS.get(site, 0)
+    if n >= k:
+        return None
+    _next_count(site)
+    _note("tear_send")
+    rng = random.Random((cfg.seed << 16) ^ n)
+    return rng.randrange(1, max(2, frame_len))
+
+
+def wrap_blocks(blocks: Iterable) -> Iterator:
+    """Wrap a streaming block iterator so each block crosses the ``block``
+    fault site (kill/stall at a seeded block index) before it reaches the
+    engine."""
+    for item in blocks:
+        fault("block")
+        yield item

@@ -18,21 +18,28 @@ import glob
 import hmac
 import json
 import os
+import random
 import socket
 import subprocess
 import sys
 import tempfile
 import threading
+import time
 import uuid
 from typing import Any, Dict, Optional
 
+from distkeras_tpu import chaos as _chaos
 from distkeras_tpu import telemetry
+from distkeras_tpu.fleet import FleetMembership
 from distkeras_tpu.networking import connect, recv_data, send_data
 from distkeras_tpu.sanitizer import lockwatch
 
 __all__ = ["Job", "PunchcardServer"]
 
 DEFAULT_PORT = 8000
+# retained replies for retried submit/serve, keyed by client idempotency key
+# (bounded FIFO — a retry storm must not grow daemon memory unboundedly)
+_IDEMPOTENCY_CACHE = 256
 
 
 def _collect_job_snapshot(tel_dir: str) -> Optional[dict]:
@@ -70,10 +77,15 @@ def _collect_job_snapshot(tel_dir: str) -> Optional[dict]:
 class PunchcardServer:
     """Queue-and-run daemon for packaged training jobs."""
 
-    def __init__(self, port: int = DEFAULT_PORT, secret: str = "", workdir: Optional[str] = None):
+    def __init__(self, port: int = DEFAULT_PORT, secret: str = "",
+                 workdir: Optional[str] = None, handler_timeout: float = 30.0,
+                 lease: float = 10.0, lease_misses: int = 2):
         self.port = port
         self.secret = secret
         self.workdir = workdir or tempfile.mkdtemp(prefix="punchcard_")
+        #: per-connection deadline on handler sockets: a half-open client
+        #: must time out instead of pinning a handler thread forever
+        self.handler_timeout = handler_timeout
         # Under DISTKERAS_SANITIZE the cv is wrapped by the lock-order
         # watchdog (acquisition-order graph, off-lock wait/notify checks)
         # and the jobs dict rejects mutation off the cv — DK105's runtime
@@ -88,6 +100,14 @@ class PunchcardServer:
         # long-running `serve` jobs: job_id -> Popen (the FIFO runner only
         # handles run-to-completion scripts; a serving engine never exits)
         self._serving: Dict[str, subprocess.Popen] = {}
+        # elastic-fleet membership (register/heartbeat/deregister/membership
+        # verbs).  Same lock domain as queue + jobs: every access goes
+        # through self._cv, so the lock-order graph stays a single node.
+        self.fleet = FleetMembership(lease=lease, miss_tolerance=lease_misses)
+        # idempotency-key -> reply replay cache for retried submit/serve
+        self._idempotent: Dict[str, dict] = {}
+        self._idempotent_order: list[str] = []
+        self._evictions_exported = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -145,23 +165,43 @@ class PunchcardServer:
     def _authorized(self, msg: dict) -> bool:
         return hmac.compare_digest(str(msg.get("secret", "")), self.secret)
 
+    def _remember(self, idem: Optional[str], reply: dict) -> None:
+        """Retain ``reply`` under the client's idempotency key (caller holds
+        the cv) so a retried submit/serve replays the original outcome
+        instead of double-enqueuing."""
+        if not idem:
+            return
+        if idem not in self._idempotent:
+            self._idempotent_order.append(idem)
+            while len(self._idempotent_order) > _IDEMPOTENCY_CACHE:
+                self._idempotent.pop(self._idempotent_order.pop(0), None)
+        self._idempotent[idem] = reply
+
     def _handle(self, conn: socket.socket) -> None:
         try:
+            # per-connection deadline: recv_data on a half-open client must
+            # raise instead of pinning this handler thread forever
+            conn.settimeout(self.handler_timeout)
             msg = recv_data(conn)
             if not self._authorized(msg):
                 send_data(conn, {"status": "denied"})
                 return
             action = msg.get("action")
+            idem = msg.get("idempotency")
             if action == "submit":
-                job_id = uuid.uuid4().hex
                 with self._cv:
-                    self.jobs[job_id] = {"status": "queued", "output": "",
-                                         "returncode": None, "metrics": None,
-                                         "script": msg["script"],
-                                         "args": msg.get("args", [])}
-                    self._queue.append(job_id)
-                    self._cv.notify()
-                send_data(conn, {"status": "queued", "job_id": job_id})
+                    reply = self._idempotent.get(idem) if idem else None
+                    if reply is None:
+                        job_id = uuid.uuid4().hex
+                        self.jobs[job_id] = {"status": "queued", "output": "",
+                                             "returncode": None, "metrics": None,
+                                             "script": msg["script"],
+                                             "args": msg.get("args", [])}
+                        self._queue.append(job_id)
+                        self._cv.notify()
+                        reply = {"status": "queued", "job_id": job_id}
+                        self._remember(idem, reply)
+                send_data(conn, reply)
             elif action == "serve":
                 # Host a long-running serving engine as a job: launched
                 # detached (Popen) because the FIFO runner blocks until a
@@ -170,6 +210,14 @@ class PunchcardServer:
                 # endpoint, and block; its flightdeck exporter port is
                 # forced on so the engine is reachable, and discoverable
                 # through the usual discovery-file -> status-verb path.
+                # Idempotency here guards the sequential-retry case (a client
+                # whose reply was lost re-sends the same key); the replay
+                # check happens before any process is spawned.
+                with self._cv:
+                    cached = self._idempotent.get(idem) if idem else None
+                if cached is not None:
+                    send_data(conn, cached)
+                    return
                 job_id = uuid.uuid4().hex
                 script_path = os.path.join(self.workdir, f"{job_id}.py")
                 with open(script_path, "w") as f:
@@ -179,7 +227,9 @@ class PunchcardServer:
                        "metrics": None, "script": msg["script"],
                        "args": msg.get("args", []), "log_path": None,
                        "serve_flags": flags if isinstance(flags, dict) else {}}
-                env, _tel_dir = self._job_env(job_id, job, ensure_http=True)
+                env, tel_dir = self._job_env(job_id, ensure_http=True)
+                if tel_dir is not None:
+                    job["telemetry_dir"] = tel_dir
                 if job["serve_flags"]:
                     # engine knobs (prefill_buckets, spec_tokens, ...) ride
                     # to the child as JSON; the script reads them back via
@@ -195,22 +245,56 @@ class PunchcardServer:
                         stdout=log, stderr=subprocess.STDOUT,
                         cwd=self.workdir, env=env,
                     )
+                reply = {"status": "serving", "job_id": job_id}
                 with self._cv:
                     self.jobs[job_id] = job
-                self._serving[job_id] = proc
+                    self._serving[job_id] = proc
+                    self._remember(idem, reply)
+                    n_serving = len(self._serving)
                 if telemetry.enabled():
                     telemetry.metrics.gauge(
                         "punchcard_serving_jobs",
                         help="serve-verb engines currently hosted",
-                    ).set(len(self._serving))
-                send_data(conn, {"status": "serving", "job_id": job_id})
+                    ).set(n_serving)
+                send_data(conn, reply)
             elif action == "stop_serving":
                 job_id = msg.get("job_id", "")
-                if job_id not in self._serving:
-                    send_data(conn, {"status": "unknown"})
-                else:
-                    self._stop_serving_job(job_id)
+                if self._stop_serving_job(job_id):
                     send_data(conn, {"status": "stopped", "job_id": job_id})
+                else:
+                    send_data(conn, {"status": "unknown"})
+            elif action == "register":
+                with self._cv:
+                    self.fleet.sweep()
+                    wid = self.fleet.register(
+                        msg.get("worker_id") or None,
+                        int(msg.get("workers") or 1), msg.get("host"))
+                    reply = {"status": "ok", "worker_id": wid,
+                             "lease": self.fleet.lease,
+                             "epoch": self.fleet.epoch}
+                    self._export_fleet_metrics()
+                send_data(conn, reply)
+            elif action == "heartbeat":
+                with self._cv:
+                    self.fleet.sweep()
+                    alive = self.fleet.heartbeat(str(msg.get("worker_id") or ""))
+                    reply = ({"status": "ok", "epoch": self.fleet.epoch}
+                             if alive else {"status": "unknown"})
+                    self._export_fleet_metrics()
+                send_data(conn, reply)
+            elif action == "deregister":
+                with self._cv:
+                    known = self.fleet.deregister(str(msg.get("worker_id") or ""))
+                    reply = {"status": "ok" if known else "unknown",
+                             "epoch": self.fleet.epoch}
+                    self._export_fleet_metrics()
+                send_data(conn, reply)
+            elif action == "membership":
+                with self._cv:
+                    self.fleet.sweep()
+                    reply = {"status": "ok", **self.fleet.snapshot()}
+                    self._export_fleet_metrics()
+                send_data(conn, reply)
             elif action == "status":
                 job = self.jobs.get(msg.get("job_id", ""))
                 if job is None:
@@ -252,6 +336,14 @@ class PunchcardServer:
                 send_data(conn, {"status": "ok", **self._fleet_snapshot()})
             else:
                 send_data(conn, {"status": "bad_request"})
+        except TimeoutError:
+            # handler deadline hit (half-open or glacial client) — drop the
+            # connection, count it, keep the thread pool healthy
+            if telemetry.enabled():
+                telemetry.metrics.counter(
+                    "punchcard_handler_timeouts_total",
+                    help="handler sockets dropped at the connection deadline",
+                ).inc()
         except (ConnectionError, ValueError, OSError):
             pass
         except Exception:
@@ -262,20 +354,44 @@ class PunchcardServer:
         finally:
             conn.close()
 
-    def _job_env(self, job_id: str, job: dict,
-                 ensure_http: bool = False) -> tuple:
+    def _export_fleet_metrics(self) -> None:
+        """Fleet gauges into the telemetry registry (caller holds the cv —
+        registry updates are cheap and never block).  They ride the same
+        flightdeck ``/vars`` + ``aggregate``-verb path as every other
+        daemon metric."""
+        if not telemetry.enabled():
+            return
+        telemetry.metrics.gauge(
+            "fleet_members", help="workers holding a live lease"
+        ).set(len(self.fleet.members))
+        telemetry.metrics.gauge(
+            "fleet_workers", help="summed logical workers across members"
+        ).set(self.fleet.workers_total())
+        telemetry.metrics.gauge(
+            "fleet_membership_epoch",
+            help="monotonic membership epoch (bumps on join/leave/evict)",
+        ).set(self.fleet.epoch)
+        delta = self.fleet.evictions - self._evictions_exported
+        if delta:
+            telemetry.metrics.counter(
+                "fleet_evictions_total",
+                help="workers evicted on a missed lease",
+            ).inc(delta)
+            self._evictions_exported = self.fleet.evictions
+
+    def _job_env(self, job_id: str, ensure_http: bool = False) -> tuple:
         """Telemetry environment for a spawned job: its own telemetry
         subdirectory (so the ``aggregate`` verb can collect snapshots
         without jobs clobbering each other), the fleet run_id (dktrace
         merge joins on it), and an ephemeral flightdeck exporter when the
         daemon itself is scrape-able — or unconditionally for ``serve``
         jobs (``ensure_http``), whose /generate endpoint lives on it.
-        Returns ``(env, tel_dir)``, both ``None`` when telemetry is off."""
+        Returns ``(env, tel_dir)``, both ``None`` when telemetry is off;
+        the caller records ``tel_dir`` on the job dict under the cv."""
         if not telemetry.enabled():
             return None, None
         tel_dir = os.path.join(self.workdir, "telemetry", job_id)
         os.makedirs(tel_dir, exist_ok=True)
-        job["telemetry_dir"] = tel_dir
         env = dict(os.environ, DISTKERAS_TELEMETRY="1",
                    DISTKERAS_TELEMETRY_DIR=tel_dir,
                    DISTKERAS_RUN_ID=telemetry.flightdeck.run_id())
@@ -285,19 +401,30 @@ class PunchcardServer:
 
     def _refresh_serving(self, job_id: str, job: dict) -> None:
         """Fold a serve job's process state into its status: a serving
-        engine that exited did not finish — it died (or was stopped)."""
-        proc = self._serving.get(job_id)
+        engine that exited did not finish — it died (or was stopped).
+        The log read happens off-lock; the job/ _serving mutations go under
+        the cv (GuardedMap only polices the map itself — mutations of the
+        inner job dicts are invisible to it, so the discipline must hold by
+        construction here)."""
+        with self._cv:
+            proc = self._serving.get(job_id)
         if proc is None or proc.poll() is None:
             return
-        job["returncode"] = proc.returncode
-        job["status"] = "failed" if proc.returncode else "finished"
-        job["output"] = self._read_log(job)
-        self._serving.pop(job_id, None)
+        output = self._read_log(job)
+        with self._cv:
+            job["returncode"] = proc.returncode
+            job["status"] = "failed" if proc.returncode else "finished"
+            job["output"] = output
+            self._serving.pop(job_id, None)
 
-    def _stop_serving_job(self, job_id: str) -> None:
-        proc = self._serving.pop(job_id, None)
+    def _stop_serving_job(self, job_id: str) -> bool:
+        """Terminate a serving job; ``False`` when no such job is live.
+        The pop is atomic under the cv, the terminate/wait runs off-lock."""
+        with self._cv:
+            proc = self._serving.pop(job_id, None)
+            n_serving = len(self._serving)
         if proc is None:
-            return
+            return False
         proc.terminate()
         try:
             proc.wait(timeout=10)
@@ -305,15 +432,18 @@ class PunchcardServer:
             proc.kill()
             proc.wait(timeout=10)
         job = self.jobs.get(job_id)
+        output = self._read_log(job) if job is not None else ""
         if job is not None:
-            job["status"] = "stopped"
-            job["returncode"] = proc.returncode
-            job["output"] = self._read_log(job)
+            with self._cv:
+                job["status"] = "stopped"
+                job["returncode"] = proc.returncode
+                job["output"] = output
         if telemetry.enabled():
             telemetry.metrics.gauge(
                 "punchcard_serving_jobs",
                 help="serve-verb engines currently hosted",
-            ).set(len(self._serving))
+            ).set(n_serving)
+        return True
 
     @staticmethod
     def _read_log(job: dict) -> str:
@@ -331,15 +461,27 @@ class PunchcardServer:
             with self._cv:
                 while self._running and not self._queue:
                     self._cv.wait(timeout=0.5)
+                    # the runner's idle wakeups double as the lease sweeper:
+                    # an expired worker is evicted (and the membership epoch
+                    # bumped) within ~0.5 s even with no verb traffic
+                    if self.fleet.sweep():
+                        self._export_fleet_metrics()
                 if not self._running:
                     return
                 job_id = self._queue.pop(0)
-            job = self.jobs[job_id]
-            job["status"] = "running"
+                # job lookup + status flip under the cv (previously both
+                # raced the handler threads from outside the lock)
+                job = self.jobs[job_id]
+                job["status"] = "running"
+                script = job["script"]
+                args = list(job["args"])
             script_path = os.path.join(self.workdir, f"{job_id}.py")
             with open(script_path, "w") as f:
-                f.write(job["script"])
-            env, tel_dir = self._job_env(job_id, job)
+                f.write(script)
+            env, tel_dir = self._job_env(job_id)
+            if tel_dir is not None:
+                with self._cv:
+                    job["telemetry_dir"] = tel_dir
             try:
                 # the job_run span is dktrace merge's clock-skew anchor: a
                 # job's own trace starts at its process-local perf origin,
@@ -347,18 +489,21 @@ class PunchcardServer:
                 # daemon-side dispatch window
                 with telemetry.trace.span("job_run", job_id=job_id):
                     proc = subprocess.run(
-                        [sys.executable, script_path, *map(str, job["args"])],
+                        [sys.executable, script_path, *map(str, args)],
                         capture_output=True, text=True, timeout=3600, cwd=self.workdir,
                         env=env,
                     )
-                job["output"] = proc.stdout + proc.stderr
-                job["returncode"] = proc.returncode
+                with self._cv:
+                    job["output"] = proc.stdout + proc.stderr
+                    job["returncode"] = proc.returncode
                 outcome = "finished" if proc.returncode == 0 else "failed"
             except subprocess.TimeoutExpired:
                 outcome = "timeout"
             if tel_dir is not None:
                 with telemetry.trace.span("job_collect", job_id=job_id):
-                    job["metrics"] = _collect_job_snapshot(tel_dir)
+                    snapshot = _collect_job_snapshot(tel_dir)
+                with self._cv:
+                    job["metrics"] = snapshot
             if telemetry.enabled():
                 telemetry.metrics.counter(
                     "punchcard_jobs_finished_total" if outcome == "finished"
@@ -379,7 +524,8 @@ class PunchcardServer:
                 telemetry.flush()
             # status last: clients poll it as the completion signal, so the
             # job's fleet snapshot must already be in place when it flips
-            job["status"] = outcome
+            with self._cv:
+                job["status"] = outcome
 
     def _job_http_address(self, job: dict) -> Optional[str]:
         """The job's live flightdeck address, from the discovery file its
@@ -467,24 +613,60 @@ class Job:
     (reference parity: ``job_deployment.py :: Job``)."""
 
     def __init__(self, host: str, port: int = DEFAULT_PORT, secret: str = "",
-                 script: str = "", args: Optional[list] = None):
+                 script: str = "", args: Optional[list] = None,
+                 rpc_timeout: float = 30.0, rpc_retries: int = 3,
+                 rpc_backoff: float = 0.1):
         self.host = host
         self.port = port
         self.secret = secret
         self.script = script
         self.args = args or []
         self.job_id: Optional[str] = None
+        #: socket deadline per RPC attempt (connect + send + recv)
+        self.rpc_timeout = rpc_timeout
+        #: transport-failure retries per RPC (0 = single attempt)
+        self.rpc_retries = rpc_retries
+        #: base of the capped exponential retry backoff (x0.5–1.0 jitter)
+        self.rpc_backoff = rpc_backoff
 
     def _rpc(self, message: dict) -> Any:
-        sock = connect(self.host, self.port)
-        try:
-            send_data(sock, {**message, "secret": self.secret})
-            return recv_data(sock)
-        finally:
-            sock.close()
+        """One control-plane round trip, retried on transport faults.
+
+        Retries are safe for every verb: reads are idempotent by nature and
+        the mutating verbs (``submit``/``serve``) carry an idempotency key
+        the daemon replays, so a retry after a lost *reply* cannot
+        double-enqueue.  Backoff is capped exponential with jitter so a
+        fleet of recovering clients doesn't stampede the daemon."""
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.rpc_retries + 1):
+            if attempt and self.rpc_backoff > 0:
+                delay = min(2.0, self.rpc_backoff * (2 ** (attempt - 1)))
+                time.sleep(delay * (0.5 + 0.5 * random.random()))
+            try:
+                sock = connect(self.host, self.port, timeout=self.rpc_timeout)
+                try:
+                    sock.settimeout(self.rpc_timeout)
+                    send_data(sock, {**message, "secret": self.secret})
+                    if _chaos.enabled():
+                        # lost-reply injection: the request reached the
+                        # daemon, the reply never reaches us — the exact
+                        # scenario idempotency keys exist for
+                        _chaos.fault("rpc_reply")
+                    return recv_data(sock)
+                finally:
+                    sock.close()
+            except (ConnectionError, TimeoutError, ValueError, OSError) as e:
+                last_exc = e
+        assert last_exc is not None
+        raise last_exc
 
     def submit(self) -> str:
-        reply = self._rpc({"action": "submit", "script": self.script, "args": self.args})
+        # one idempotency key per logical submit, constant across _rpc's
+        # transport retries: the daemon replays the original reply instead
+        # of enqueuing a second job
+        reply = self._rpc({"action": "submit", "script": self.script,
+                           "args": self.args,
+                           "idempotency": uuid.uuid4().hex})
         if reply.get("status") != "queued":
             raise RuntimeError(f"submission rejected: {reply}")
         self.job_id = reply["job_id"]
@@ -507,7 +689,8 @@ class Job:
         ``DISTKERAS_SERVE_FLAGS`` env var; the script reads it back with
         :func:`distkeras_tpu.serving.serve_flags`, so one serving script
         can be deployed under many engine configurations."""
-        msg = {"action": "serve", "script": self.script, "args": self.args}
+        msg = {"action": "serve", "script": self.script, "args": self.args,
+               "idempotency": uuid.uuid4().hex}
         if flags is not None:
             msg["flags"] = dict(flags)
         reply = self._rpc(msg)
@@ -528,11 +711,11 @@ class Job:
                         poll: float = 0.2) -> str:
         """Block until the serving job's flightdeck exporter is
         discoverable and return its ``host:port``."""
-        import time
-
         deadline = time.monotonic() + timeout
+        polls = 0
         while time.monotonic() < deadline:
             st = self.status()
+            polls += 1
             if st.get("status") not in ("serving",):
                 raise RuntimeError(f"serving job is {st.get('status')}: "
                                    f"{st.get('output', '')[-2000:]}")
@@ -540,7 +723,11 @@ class Job:
             if addr:
                 return addr
             time.sleep(poll)
-        raise TimeoutError(f"serving job {self.job_id} published no address")
+        # polls may be 0 (timeout <= 0): the message must not read from the
+        # loop-local status — previously an UnboundLocalError
+        raise TimeoutError(
+            f"serving job {self.job_id} published no address after {polls} "
+            f"poll(s) in {timeout}s")
 
     def metrics(self, job_id: Optional[str] = None) -> dict:
         """Scrape the daemon's telemetry registry (``metrics`` verb):
@@ -564,14 +751,20 @@ class Job:
         return self._rpc({"action": "aggregate"})
 
     def wait(self, timeout: float = 300.0, poll: float = 0.2) -> dict:
-        import time
-
         # monotonic, not wall-clock: an NTP step mid-poll must not shrink or
         # stretch the deadline (dklint DK106)
         deadline = time.monotonic() + timeout
+        st: Optional[dict] = None
+        polls = 0
         while time.monotonic() < deadline:
             st = self.status()
+            polls += 1
             if st["status"] in ("finished", "failed", "timeout"):
                 return st
             time.sleep(poll)
-        raise TimeoutError(f"job {self.job_id} still {st['status']}")
+        # with timeout <= 0 the loop never runs; st stays None (previously
+        # this raise hit an UnboundLocalError)
+        last = st["status"] if st is not None else "unpolled"
+        raise TimeoutError(
+            f"job {self.job_id} still {last} after {polls} poll(s) in "
+            f"{timeout}s")

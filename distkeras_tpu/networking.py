@@ -46,7 +46,8 @@ def determine_host_address() -> str:
     ``networking.py :: determine_host_address``)."""
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
-        s.connect(("8.8.8.8", 80))  # no packet is sent for UDP connect
+        # UDP connect sends no packet and cannot block on a peer
+        s.connect(("8.8.8.8", 80))  # dklint: disable=DK115
         return s.getsockname()[0]
     except OSError:
         return socket.gethostbyname(socket.gethostname())
@@ -86,7 +87,13 @@ def shutdown() -> None:
 # -- control-plane wire helpers (job deployment) ---------------------------
 
 def connect(host: str, port: int, timeout: float = 30.0) -> socket.socket:
-    """TCP connect with NODELAY (reference parity: ``networking.py :: connect``)."""
+    """TCP connect with NODELAY (reference parity: ``networking.py :: connect``).
+    The timeout stays applied on the returned socket — callers inherit a
+    deadline on every subsequent send/recv unless they override it."""
+    from distkeras_tpu import chaos
+
+    if chaos.enabled():
+        chaos.fault("connect")  # seeded ConnectionRefusedError injection
     sock = socket.create_connection((host, port), timeout=timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return sock
@@ -143,17 +150,31 @@ def send_data(sock: socket.socket, obj: Any) -> None:
     from distkeras_tpu.sanitizer import lockwatch
 
     payload = _encode(obj)
+    frame = _MAGIC + struct.pack("!Q", len(payload)) + payload
     # one frame must hit the wire atomically per socket: the sanitizer's
     # exclusivity guard flags concurrent sends from two threads, which
     # would interleave length-prefixed frames and tear the stream
     with lockwatch.exclusive(sock, "send_data on one socket"):
-        sock.sendall(_MAGIC + struct.pack("!Q", len(payload)) + payload)
+        from distkeras_tpu import chaos
+
+        if chaos.enabled():
+            # tear check first (it consumes the site counter only when it
+            # fires); the delay fault below is skipped for a torn frame
+            torn = chaos.tear_bytes("send", len(frame))
+            if torn is not None:
+                sock.sendall(frame[:torn])
+                raise ConnectionError(
+                    f"chaos: frame torn after {torn}/{len(frame)} bytes")
+            chaos.fault("send")
+        sock.sendall(frame)
 
 
 def _recvall(sock: socket.socket, n: int) -> bytes:
     chunks = []
     while n > 0:
-        chunk = sock.recv(min(n, 1 << 20))
+        # timeout is the caller's contract: connect() applies one and the
+        # daemon sets conn.settimeout() before recv_data
+        chunk = sock.recv(min(n, 1 << 20))  # dklint: disable=DK115
         if not chunk:
             raise ConnectionError("socket closed mid-message")
         chunks.append(chunk)
@@ -163,8 +184,11 @@ def _recvall(sock: socket.socket, n: int) -> bytes:
 
 def recv_data(sock: socket.socket) -> Any:
     """Length-prefixed message receive (reference parity: ``recv_data``)."""
+    from distkeras_tpu import chaos
     from distkeras_tpu.sanitizer import lockwatch
 
+    if chaos.enabled():
+        chaos.fault("recv")  # seeded ConnectionError before the read
     with lockwatch.exclusive(sock, "recv_data on one socket"):
         header = _recvall(sock, 12)
         if header[:4] != _MAGIC:

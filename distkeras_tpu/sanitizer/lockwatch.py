@@ -178,7 +178,14 @@ def maybe_wrap(lock, name: str):
 class GuardedMap(dict):
     """Dict whose mutations must happen while ``lock`` is held by the
     calling thread (reads stay free — CPython dict reads are atomic and the
-    daemon's status polls rely on that)."""
+    daemon's status polls rely on that).
+
+    Known blind spot: only mutations of *this* mapping are policed.
+    Mutating a value fetched from it (``guarded[k]["field"] = v``) is an
+    ordinary inner-dict write the guard never sees — exactly the shape of
+    the daemon races fixed in PR 11 (``_runner_loop`` flipping
+    ``job["status"]`` off-lock).  Discipline for nested state must hold by
+    construction: fetch under the lock, mutate under the lock."""
 
     def __init__(self, data, lock: GuardedLock, name: str):
         super().__init__(data)

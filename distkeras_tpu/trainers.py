@@ -28,6 +28,8 @@ from typing import Any, List, Optional, Sequence
 import jax
 import numpy as np
 
+from distkeras_tpu import chaos as _chaos
+from distkeras_tpu import fleet as _fleet
 from distkeras_tpu import sanitizer
 from distkeras_tpu import telemetry
 from distkeras_tpu import workers as workers_mod
@@ -54,6 +56,7 @@ __all__ = [
     "EAMSGD",
     "ADAG",
     "DynSGD",
+    "AdaptiveDynSGD",
 ]
 
 
@@ -332,6 +335,76 @@ class Trainer:
             ).inc()
         return state
 
+    def _apply_staleness_bound(self, policy, summary, state):
+        """Feed the finished epoch's dynamics summary to the host-side
+        staleness policy and swap the rule's ``staleness_bound`` leaf with
+        the bound it returns.  The leaf is traced *data* (same float32
+        scalar shape), so the swap never retraces the epoch program; rules
+        without the leaf (plain DynSGD) pass through untouched."""
+        from distkeras_tpu.algorithms.adaptive import BOUND_KEY
+
+        if BOUND_KEY not in state.center_rule:
+            return state
+        import jax.numpy as jnp
+
+        bound = float(policy.observe(summary))
+        rule_state = dict(state.center_rule)
+        rule_state[BOUND_KEY] = jnp.asarray(bound, jnp.float32)
+        if telemetry.enabled():
+            telemetry.metrics.gauge(
+                "dynamics_staleness_bound",
+                help="adaptive DynSGD staleness bound in force",
+            ).set(bound)
+        return state.replace(center_rule=rule_state)
+
+    def _elastic_resize(self, build_engine, engine, state, ckpt, epoch, rng,
+                        shuffle, new_workers):
+        """Mid-run worker-count change at an epoch boundary: drain to a
+        boundary checkpoint, gather the center off the old engine, re-plan,
+        and rebuild state at ``new_workers`` via the same
+        ``state_from_center`` path an elastic *resume* takes — but live, with
+        no process restart.  Progress (center params, rule counters, epoch)
+        carries over; local replicas re-pull the center, exactly the
+        reference's worker-(re)connect semantics."""
+        from distkeras_tpu.parallel.engine import plan_workers
+
+        if ckpt is not None:
+            # leave a boundary checkpoint first: if the rebuild dies (OOM on
+            # a shrunken mesh, say), train_with_recovery resumes from here
+            from distkeras_tpu.datapipe import DataState
+
+            ckpt.save_partial(state, epoch, DataState(
+                epoch=epoch + 1, block_cursor=0,
+                rng_state=(rng.bit_generator.state if shuffle else None)))
+            ckpt.wait()
+        from distkeras_tpu.checkpoint import worker_mean
+
+        center = jax.tree.map(np.asarray, engine.gather_center(state))
+        center_rule = jax.tree.map(np.asarray, state.center_rule)
+        # per-worker model state reduces to its worker mean — the same
+        # semantic sync_model_state applies at every commit boundary
+        model_state = jax.tree.map(
+            lambda v: worker_mean(np.asarray(v)), state.model_state)
+        devices_used, _ = plan_workers(new_workers, jax.device_count())
+        engine.clear_program_cache()
+        new_engine = build_engine(new_workers)
+        new_state = new_engine.state_from_center(
+            jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch + 1),
+            center, center_rule, model_state, epoch + 1,
+        )
+        if telemetry.enabled():
+            telemetry.metrics.counter(
+                "elastic_resizes_total",
+                help="mid-run worker-count rebuilds",
+            ).inc()
+            telemetry.metrics.gauge(
+                "elastic_workers", help="current logical worker count"
+            ).set(new_workers)
+            telemetry.metrics.gauge(
+                "elastic_devices", help="devices the worker axis occupies"
+            ).set(devices_used)
+        return new_engine, new_state
+
     def _fit(self, *args, **kwargs):
         """Crash-forensics boundary around :meth:`_fit_inner`.
 
@@ -373,73 +446,77 @@ class Trainer:
             metrics = per_token_metric_names(metrics)
         with telemetry.trace.span("load_columns", phase="data"):
             feats, labels = self._load_columns(dataframe)
-        if self.pipeline_stages > 1:
-            if self.tp_spec_fn is not None:
-                raise ValueError(
-                    "tp_spec_fn is a GSPMD-engine override; the pipeline "
-                    "engine places the model axis by its staged-leaf shape "
-                    "rule"
-                )
-            if commit_schedule is not None:
-                raise ValueError(
-                    "pipeline_stages>1 is incompatible with commit_schedule "
-                    "(the staleness simulation dispatches per step)"
-                )
-            if getattr(adapter, "num_stages", None) != self.pipeline_stages:
-                raise ValueError(
-                    f"pipeline_stages={self.pipeline_stages} needs a staged "
-                    f"adapter with num_stages={self.pipeline_stages} (e.g. "
-                    "models.StagedTransformer); got "
-                    f"{type(self.master_model).__name__}"
-                )
-            from distkeras_tpu.parallel.pipeline import PipelineEngine
 
-            engine = PipelineEngine(
-                adapter,
-                self.loss,
-                self._effective_worker_optimizer(),
-                rule,
-                num_workers,
-                microbatches=self.pp_microbatches,
-                tp_shards=self.tp_shards,
-                seq_shards=self.seq_shards,
-                fsdp=self.fsdp,
-                metrics=metrics,
-                compute_dtype=self.compute_dtype,
-                remat=self.remat,
-                unroll=self.unroll,
-            )
-        elif self.tp_shards > 1 or (self.fsdp and self.seq_shards == 1):
-            if self.seq_shards > 1:
-                raise ValueError(
-                    "tp_shards>1 (GSPMD engine) is incompatible with "
-                    "seq_shards>1 (ring attention needs the shard_map "
-                    "engine); fsdp + seq_shards IS supported — drop tp_shards"
-                )
-            from distkeras_tpu.parallel.gspmd import GSPMDEngine
+        # One engine-construction recipe, parameterised by worker count, so
+        # an elastic resize can re-plan and rebuild mid-run with exactly the
+        # configuration the original engine was built under.
+        def build_engine(n_workers: int):
+            if self.pipeline_stages > 1:
+                if self.tp_spec_fn is not None:
+                    raise ValueError(
+                        "tp_spec_fn is a GSPMD-engine override; the pipeline "
+                        "engine places the model axis by its staged-leaf shape "
+                        "rule"
+                    )
+                if commit_schedule is not None:
+                    raise ValueError(
+                        "pipeline_stages>1 is incompatible with commit_schedule "
+                        "(the staleness simulation dispatches per step)"
+                    )
+                if getattr(adapter, "num_stages", None) != self.pipeline_stages:
+                    raise ValueError(
+                        f"pipeline_stages={self.pipeline_stages} needs a staged "
+                        f"adapter with num_stages={self.pipeline_stages} (e.g. "
+                        "models.StagedTransformer); got "
+                        f"{type(self.master_model).__name__}"
+                    )
+                from distkeras_tpu.parallel.pipeline import PipelineEngine
 
-            engine = GSPMDEngine(
+                return PipelineEngine(
+                    adapter,
+                    self.loss,
+                    self._effective_worker_optimizer(),
+                    rule,
+                    n_workers,
+                    microbatches=self.pp_microbatches,
+                    tp_shards=self.tp_shards,
+                    seq_shards=self.seq_shards,
+                    fsdp=self.fsdp,
+                    metrics=metrics,
+                    compute_dtype=self.compute_dtype,
+                    remat=self.remat,
+                    unroll=self.unroll,
+                )
+            if self.tp_shards > 1 or (self.fsdp and self.seq_shards == 1):
+                if self.seq_shards > 1:
+                    raise ValueError(
+                        "tp_shards>1 (GSPMD engine) is incompatible with "
+                        "seq_shards>1 (ring attention needs the shard_map "
+                        "engine); fsdp + seq_shards IS supported — drop tp_shards"
+                    )
+                from distkeras_tpu.parallel.gspmd import GSPMDEngine
+
+                return GSPMDEngine(
+                    adapter,
+                    self.loss,
+                    self._effective_worker_optimizer(),
+                    rule,
+                    n_workers,
+                    tp_shards=self.tp_shards,
+                    fsdp=self.fsdp,
+                    spec_fn=self.tp_spec_fn,
+                    metrics=metrics,
+                    compute_dtype=self.compute_dtype,
+                    commit_schedule=commit_schedule,
+                    remat=self.remat,
+                    unroll=self.unroll,
+                )
+            return WindowedEngine(
                 adapter,
                 self.loss,
                 self._effective_worker_optimizer(),
                 rule,
-                num_workers,
-                tp_shards=self.tp_shards,
-                fsdp=self.fsdp,
-                spec_fn=self.tp_spec_fn,
-                metrics=metrics,
-                compute_dtype=self.compute_dtype,
-                commit_schedule=commit_schedule,
-                remat=self.remat,
-                unroll=self.unroll,
-            )
-        else:
-            engine = WindowedEngine(
-                adapter,
-                self.loss,
-                self._effective_worker_optimizer(),
-                rule,
-                num_workers,
+                n_workers,
                 metrics=metrics,
                 compute_dtype=self.compute_dtype,
                 commit_schedule=commit_schedule,
@@ -450,6 +527,8 @@ class Trainer:
                 remat=self.remat,
                 unroll=self.unroll,
             )
+
+        engine = build_engine(num_workers)
         window = rule.communication_window if rule.communication_window > 0 else None
         rng = np.random.default_rng(self.seed)
 
@@ -501,6 +580,37 @@ class Trainer:
                     "dispatch_epochs>1 runs whole chunks per dispatch with no "
                     "epoch boundary to restore at"
                 )
+
+        # Elastic membership: poll the fleet's membership epoch at epoch
+        # boundaries and resize the worker set mid-run.  Only meaningful for
+        # committing rules (progress must live in the center to carry across
+        # a rebuild) on the per-epoch loop.
+        elastic_ctl = getattr(self, "elastic", None)
+        if elastic_ctl is not None and (
+            rule.communication_window <= 0
+            or commit_schedule is not None
+            or self.pipeline_stages > 1
+            or self.dispatch_epochs > 1
+        ):
+            warnings.warn(
+                "elastic membership polling disabled: it requires a "
+                "committing rule on the per-epoch loop (no commit_schedule, "
+                "pipeline_stages=1, dispatch_epochs=1)",
+                RuntimeWarning,
+            )
+            elastic_ctl = None
+
+        # AdaptiveBound staleness policy: applied between epochs by swapping
+        # the rule's traced staleness_bound scalar (same dtype/shape, so no
+        # retrace).  Needs the dynamics summary the telemetry layer traces.
+        staleness_policy = getattr(self, "staleness_policy", None)
+        if staleness_policy is not None and not getattr(engine, "_dynamics", False):
+            warnings.warn(
+                "staleness_policy set but dynamics telemetry is off "
+                "(DISTKERAS_DYNAMICS); the bound will not adapt",
+                RuntimeWarning,
+            )
+            staleness_policy = None
 
         # The elastic path builds its state straight from the partial
         # restore — a fresh init_state would be thrown away (and costs a
@@ -557,6 +667,8 @@ class Trainer:
         if telemetry.enabled():
             telemetry.install_jax_hooks()
 
+        last_summary: dict = {}
+
         def _materialise(stats, epoch_idx):
             stats = jax.tree.map(np.asarray, stats)
             dyn = stats.get("dynamics")
@@ -567,6 +679,7 @@ class Trainer:
                 # metrics JSONL
                 summary = telemetry.dynamics.summarize(dyn, loss=stats["loss"])
                 telemetry.dynamics.record(epoch_idx, dyn, summary)
+                last_summary["value"] = summary
             if scalar_log is not None:
                 scalars = {"loss": float(_epoch_mean(stats, "loss"))}
                 mets = np.asarray(stats["metrics"])
@@ -625,6 +738,8 @@ class Trainer:
                 steps = plan_epoch(len(feats), num_workers, self.batch_size, 1)[0]
                 stream_window = min(steps, 32)
             for epoch in range(start_epoch, self.num_epoch):
+                if _chaos.enabled():
+                    _chaos.fault("epoch")  # seeded kill entering this epoch
                 if prof is not None:
                     prof.on_step(epoch)
                 with telemetry.trace.span("epoch", epoch=epoch):
@@ -660,6 +775,12 @@ class Trainer:
                                 blocks, depth=self.prefetch,
                                 put_fn=engine.stream_put,
                             )
+                        if _chaos.enabled():
+                            # seeded kill/stall at a block index, downstream
+                            # of the prefetch ring so the fault reaches the
+                            # consumer directly (host-side only — the jitted
+                            # program is untouched)
+                            blocks = _chaos.wrap_blocks(blocks)
                         on_window = None
                         if ckpt is not None and self.checkpoint_blocks:
                             from distkeras_tpu.datapipe import DataState
@@ -750,6 +871,44 @@ class Trainer:
                             rng_state=(rng.bit_generator.state
                                        if shuffle else None),
                         ))
+                    if staleness_policy is not None:
+                        # adapt the staleness bound from THIS epoch's summary
+                        # (costs the one-epoch async overlap, same trade the
+                        # watchdog makes)
+                        if epoch_stats and not isinstance(
+                                jax.tree.leaves(epoch_stats[-1])[0],
+                                np.ndarray):
+                            epoch_stats[-1] = _materialise(
+                                epoch_stats[-1], epoch)
+                        summary = last_summary.get("value")
+                        if summary is not None:
+                            state = self._apply_staleness_bound(
+                                staleness_policy, summary, state)
+                    if _fleet.preemption_requested():
+                        # SIGTERM arrived: leave a boundary checkpoint for
+                        # whoever resumes, then exit loudly instead of dying
+                        # mid-step on the follow-up SIGKILL
+                        if ckpt is not None:
+                            if (epoch + 1) % self.checkpoint_every:
+                                from distkeras_tpu.datapipe import DataState
+
+                                ckpt.save_partial(state, epoch, DataState(
+                                    epoch=epoch + 1, block_cursor=0,
+                                    rng_state=(rng.bit_generator.state
+                                               if shuffle else None)))
+                            ckpt.wait()
+                        raise _fleet.Preempted(
+                            f"preempted (SIGTERM); drained to the epoch "
+                            f"{epoch + 1} boundary"
+                            + (" checkpoint" if ckpt is not None else ""))
+                    if elastic_ctl is not None and epoch + 1 < self.num_epoch:
+                        desired = elastic_ctl.poll()
+                        if desired and desired != num_workers:
+                            engine, state = self._elastic_resize(
+                                build_engine, engine, state, ckpt, epoch,
+                                rng, shuffle, desired)
+                            num_workers = desired
+                            resume_data = None
             if epoch_stats and not isinstance(
                     jax.tree.leaves(epoch_stats[-1])[0], np.ndarray):
                 epoch_stats[-1] = _materialise(epoch_stats[-1], self.num_epoch - 1)
@@ -1017,6 +1176,8 @@ class DistributedTrainer(Trainer):
         tp_spec_fn: Optional[Any] = None,
         prefetch: int = 0,
         checkpoint_blocks: int = 0,
+        elastic: Optional[Any] = None,
+        staleness_policy: Optional[Any] = None,
     ):
         super().__init__(
             keras_model, loss, worker_optimizer, metrics,
@@ -1028,6 +1189,12 @@ class DistributedTrainer(Trainer):
         )
         self.num_workers = num_workers or jax.device_count()
         self.master_port = master_port
+        #: fleet.ElasticMembership (or any object with ``poll() -> int|None``)
+        #: — polled at epoch boundaries to resize the worker set mid-run
+        self.elastic = elastic
+        #: adaptive.AdaptiveBound (or any ``observe(summary) -> float``) —
+        #: retunes an AdaptiveDynSGD rule's staleness bound between epochs
+        self.staleness_policy = staleness_policy
         self.parameter_server: Optional[ParameterServer] = None
         # Optional per-worker commit periods: the deterministic staleness
         # simulation (SURVEY.md §7 "asynchrony semantics on SPMD hardware").
@@ -1056,7 +1223,8 @@ class DistributedTrainer(Trainer):
         return self.parameter_server.num_updates if self.parameter_server else 0
 
     def train_with_recovery(self, dataframe: DataFrame, shuffle: bool = False,
-                            max_retries: int = 2):
+                            max_retries: int = 2, backoff_base: float = 0.5,
+                            backoff_cap: float = 30.0):
         """Failure-tolerant training (SURVEY.md §5.3).
 
         The reference leaned on Spark task retries (a retried worker
@@ -1070,17 +1238,26 @@ class DistributedTrainer(Trainer):
         a checkpoint exists to restore from, and never for the same exception
         signature twice in a row — a deterministic bug (shape error, OOM)
         surfaces immediately instead of being re-run ``max_retries`` times.
+        Retries back off exponentially (``backoff_base * 2^k`` capped at
+        ``backoff_cap``, x0.5–1.0 jitter) so a fleet of recovering workers
+        doesn't stampede the shared checkpoint store, and a SIGTERM
+        preemption (:class:`distkeras_tpu.fleet.Preempted`) is never
+        retried — the boundary checkpoint is on disk and the process is
+        meant to exit.
         """
         if not self.checkpoint_dir:
             raise ValueError("train_with_recovery requires checkpoint_dir")
         from distkeras_tpu.checkpoint import committed_steps, latest_step
 
+        _fleet.install_preemption_handler()
         attempts = 0
         last_failure = None
         last_step = None
         while True:
             try:
                 return self.train(dataframe, shuffle)
+            except _fleet.Preempted:
+                raise  # drained to a boundary checkpoint; exit, don't retry
             except Exception as e:  # noqa: BLE001 — re-raised unless retryable
                 failure = (type(e), str(e))
                 try:
@@ -1105,6 +1282,12 @@ class DistributedTrainer(Trainer):
                 last_failure = failure
                 last_step = step
                 self.resume = True  # pick up from the latest checkpoint
+                if backoff_base > 0:
+                    import random as _random
+
+                    delay = min(backoff_cap,
+                                backoff_base * (2 ** (attempts - 1)))
+                    time.sleep(delay * (0.5 + 0.5 * _random.random()))
 
     @property
     def _logical_workers(self) -> int:
@@ -1235,4 +1418,26 @@ class DynSGD(AsynchronousDistributedTrainer):
         return workers_mod.DynSGDWorker(
             self.worker_optimizer, self.batch_size, self.features_col,
             self.label_col, self.communication_window,
+        )
+
+
+class AdaptiveDynSGD(DynSGD):
+    """DynSGD with an SSP-style staleness bound carried in the center state
+    (beyond reference; ABS arXiv:2301.08895 / DynSSP arXiv:1908.11848).
+
+    Pass ``staleness_policy=AdaptiveBound(...)`` to retune the bound online
+    between epochs from the dynamics telemetry (needs
+    ``DISTKERAS_DYNAMICS=1``); with the default ``inf`` bound and no policy
+    the trajectory is bit-for-bit DynSGD."""
+
+    def __init__(self, *args, communication_window: int = 5,
+                 initial_bound: float = float("inf"), **kwargs):
+        super().__init__(*args, communication_window=communication_window,
+                         **kwargs)
+        self.initial_bound = initial_bound
+
+    def allocate_worker(self):
+        return workers_mod.AdaptiveDynSGDWorker(
+            self.worker_optimizer, self.batch_size, self.features_col,
+            self.label_col, self.communication_window, self.initial_bound,
         )

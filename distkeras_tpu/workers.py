@@ -35,6 +35,7 @@ __all__ = [
     "EAMSGDWorker",
     "ADAGWorker",
     "DynSGDWorker",
+    "AdaptiveDynSGDWorker",
 ]
 
 
@@ -107,3 +108,14 @@ class DynSGDWorker(Worker):
                  label_col="label", communication_window=5):
         super().__init__(optimizer, batch_size, features_col, label_col,
                          DynSGD(communication_window))
+
+
+class AdaptiveDynSGDWorker(Worker):
+    def __init__(self, optimizer="sgd", batch_size=32, features_col="features",
+                 label_col="label", communication_window=5,
+                 initial_bound=float("inf")):
+        from distkeras_tpu.algorithms.adaptive import AdaptiveDynSGD
+
+        super().__init__(optimizer, batch_size, features_col, label_col,
+                         AdaptiveDynSGD(communication_window,
+                                        initial_bound=initial_bound))

@@ -1,0 +1,35 @@
+"""DK115 fixture — socket deadlines in a server module (basename keeps it
+in scope).  Lines are pinned by tests/test_lint.py."""
+
+import socket
+
+from distkeras_tpu.networking import connect
+
+
+def bad_bare_create_connection():
+    sock = socket.create_connection(("h", 1))  # DK115: call site flagged
+    return sock.recv(16)  # derived socket not re-flagged (one per cause)
+
+
+def good_create_connection_with_timeout():
+    sock = socket.create_connection(("h", 1), timeout=5.0)
+    return sock.recv(16)
+
+
+def good_project_helper():
+    sock = connect("h", 1)  # applies a default deadline
+    return sock.recv(16)
+
+
+def good_settimeout_before_recv(sock):
+    sock.settimeout(5.0)
+    return sock.recv(16)
+
+
+def bad_param_recv(sock):
+    return sock.recv(16)  # DK115: parameter, no settimeout on the path
+
+
+def bad_accept_derived(srv):
+    conn, _ = srv.accept()  # accept on a param: DK115 (listener is bare)
+    return conn.recv(16)  # DK115: accepted sockets inherit no timeout

@@ -1,0 +1,220 @@
+"""Deterministic chaos harness tests (DISTKERAS_CHAOS).
+
+Three layers of pins: the spec parser fails loudly on typos; the off path
+is zero-cost (stock control-plane objects, byte-identical lowered
+programs); and each seeded fault proves the recovery machinery it targets
+— retried RPCs stay idempotent under dropped replies / refused connects /
+torn frames, and a seeded worker kill resumes bit-for-bit from the
+checkpoint."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu import chaos, telemetry
+from distkeras_tpu.algorithms import Downpour
+from distkeras_tpu.data import epoch_arrays
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.job_deployment import Job, PunchcardServer
+from distkeras_tpu.models import MLP, FlaxModel
+from distkeras_tpu.parallel.engine import WindowedEngine
+
+
+@pytest.fixture(autouse=True)
+def chaos_off():
+    """Each test arms its own spec; leave the process env-driven."""
+    chaos.configure("")
+    yield
+    chaos.configure(None)
+
+
+# ------------------------------------------------------------ spec parsing
+
+def test_spec_parsing_roundtrip():
+    cfg = chaos.ChaosConfig.parse("7:kill_block=5,refuse_connect=2,"
+                                  "stall_secs=0.25")
+    assert cfg.seed == 7
+    assert cfg.get("kill_block") == 5
+    assert cfg.get("refuse_connect") == 2
+    assert cfg.get("stall_secs") == 0.25
+    assert cfg.get("drop_reply") is None  # unarmed
+
+
+def test_spec_rejects_typos_loudly():
+    with pytest.raises(ValueError, match="unknown chaos spec key"):
+        chaos.ChaosConfig.parse("1:kill_epochs=3")
+    with pytest.raises(ValueError, match="<seed>:"):
+        chaos.ChaosConfig.parse("kill_epoch=3")
+    with pytest.raises(ValueError, match="key=value"):
+        chaos.ChaosConfig.parse("1:kill_epoch")
+
+
+def test_configure_and_counts():
+    assert chaos.enabled() is False
+    chaos.fault("connect")  # off: no-op, not even counted
+    assert chaos.counts() == {}
+    chaos.configure("3:refuse_connect=1")
+    assert chaos.enabled() is True
+    with pytest.raises(ConnectionRefusedError):
+        chaos.fault("connect")
+    chaos.fault("connect")  # budget of 1 spent
+    assert chaos.counts()["connect"] == 2
+
+
+def test_wrap_blocks_kills_at_seeded_block():
+    chaos.configure("1:kill_block=1")
+    got = []
+    with pytest.raises(chaos.ChaosKilled):
+        for item in chaos.wrap_blocks(iter([10, 20, 30])):
+            got.append(item)
+    assert got == [10]  # block 0 passed, block 1 killed
+    # fire-once: the retry's iterator streams through
+    assert list(chaos.wrap_blocks(iter([10, 20, 30]))) == [10, 20, 30]
+
+
+def test_tear_bytes_is_seeded_and_a_proper_prefix():
+    chaos.configure("9:tear_send=2")
+    a = chaos.tear_bytes("send", 100)
+    b = chaos.tear_bytes("send", 100)
+    assert a is not None and b is not None
+    assert 1 <= a < 100 and 1 <= b < 100
+    assert chaos.tear_bytes("send", 100) is None  # budget spent
+    chaos.configure("9:tear_send=2")  # same seed ⇒ same split points
+    assert chaos.tear_bytes("send", 100) == a
+    assert chaos.tear_bytes("send", 100) == b
+
+
+# ------------------------------------------------- zero-cost when disarmed
+
+def test_off_path_is_stock():
+    assert chaos.enabled() is False
+    assert chaos.spec() is None
+    srv = PunchcardServer(port=0)
+    assert type(srv.jobs) is dict  # no wrapping sneaks in via chaos
+
+
+def _lowered_epoch_text():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    onehot = np.zeros((64, 2), np.float32)
+    onehot[np.arange(64), (x.sum(1) > 0).astype(int)] = 1.0
+    eng = WindowedEngine(
+        FlaxModel(MLP(features=(16,), num_classes=2)),
+        loss="categorical_crossentropy",
+        worker_optimizer=("sgd", {"learning_rate": 0.1}),
+        rule=Downpour(communication_window=2), num_workers=2)
+    state = eng.init_state(jax.random.PRNGKey(0), x[:16])
+    xs, ys = epoch_arrays(x, onehot, eng.num_workers, 16, 2)
+    xs, ys = eng.shard_batches(xs, ys)
+    fn = eng._make_epoch_fn(xs.shape[1], 2, True, xs.ndim)
+    with eng.mesh:
+        return fn.lower(state, xs, ys).as_text()
+
+
+def test_chaos_lowering_byte_identical():
+    """Chaos is host-side fault injection around dispatch: arming it must
+    add ZERO traced ops — the lowered program is byte-identical."""
+    off = _lowered_epoch_text()
+    chaos.configure("7:kill_epoch=99,refuse_connect=3,tear_send=1,"
+                    "delay_send_ms=1")
+    armed = _lowered_epoch_text()
+    assert off == armed
+
+
+# ----------------------------------------- control-plane faults + idempotency
+
+@pytest.fixture()
+def daemon():
+    server = PunchcardServer(port=0, secret="s3cret")
+    server.start()
+    yield server
+    server.stop()
+
+
+def _submit(daemon):
+    job = Job("127.0.0.1", daemon.port, secret="s3cret",
+              script="print('ok')", rpc_timeout=10.0, rpc_retries=4,
+              rpc_backoff=0.01)
+    job.submit()
+    return job
+
+
+def _job_count(daemon):
+    with daemon._cv:
+        return len(daemon.jobs)
+
+
+def test_submit_survives_refused_connects(daemon):
+    chaos.configure("3:refuse_connect=2")
+    job = _submit(daemon)
+    assert job.wait(timeout=30)["status"] == "finished"
+    assert _job_count(daemon) == 1
+    assert chaos.counts()["connect"] >= 3  # two refusals then success
+
+
+def test_retried_submit_is_idempotent_under_dropped_replies(daemon):
+    """drop_reply loses the daemon's answer AFTER the request landed — the
+    client must retry, and the idempotency key must stop the retries from
+    enqueueing duplicate jobs."""
+    chaos.configure("3:drop_reply=2")
+    job = _submit(daemon)
+    assert job.wait(timeout=30)["status"] == "finished"
+    assert _job_count(daemon) == 1  # retries re-sent, daemon deduped
+
+
+def test_retried_submit_is_idempotent_under_torn_frames(daemon):
+    chaos.configure("5:tear_send=1")
+    job = _submit(daemon)
+    assert job.wait(timeout=30)["status"] == "finished"
+    assert _job_count(daemon) == 1
+
+
+def test_two_distinct_submits_stay_distinct(daemon):
+    """The idempotency key is per logical call, not per client: two real
+    submits must still enqueue two jobs."""
+    a = _submit(daemon)
+    b = _submit(daemon)
+    assert a.wait(timeout=30)["status"] == "finished"
+    assert b.wait(timeout=30)["status"] == "finished"
+    assert a.job_id != b.job_id
+    assert _job_count(daemon) == 2
+
+
+def test_rpc_exhausts_retry_budget(daemon):
+    chaos.configure("3:drop_reply=99")
+    job = Job("127.0.0.1", daemon.port, secret="s3cret", script="print(1)",
+              rpc_retries=2, rpc_backoff=0.01)
+    with pytest.raises(ConnectionError, match="reply dropped"):
+        job.submit()
+
+
+# ------------------------------------------------- seeded kill ⇒ bit-exact
+
+def _trainer(ckpt_dir, **kw):
+    return dk.DOWNPOUR(FlaxModel(MLP(features=(16,), num_classes=2)),
+                       loss="categorical_crossentropy",
+                       worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                       num_workers=4, batch_size=16, num_epoch=4,
+                       communication_window=4, seed=11,
+                       checkpoint_dir=ckpt_dir, **kw)
+
+
+def test_seeded_kill_resumes_bitwise(toy_classification, tmp_path):
+    x, _, onehot = toy_classification
+    df = from_numpy(x, onehot)
+    baseline = _trainer(None).train(df)
+
+    # the seeded kill fires once entering epoch 2; train_with_recovery
+    # resumes from the boundary checkpoint and must land on the exact
+    # same parameters as the uninterrupted run
+    chaos.configure("7:kill_epoch=2")
+    trained = _trainer(str(tmp_path)).train_with_recovery(
+        df, backoff_base=0)
+    assert chaos.counts()["epoch"] >= 3  # the fault site actually fired
+    for a, b in zip(jax.tree.leaves(baseline.params),
+                    jax.tree.leaves(trained.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,438 @@
+"""Elastic fleet: lease-based membership, preemption drain, live resize,
+adaptive staleness, and the hardened control-plane client.
+
+The reference assumed an immortal Spark executor set; these tests pin the
+PR-11 elasticity contract — workers join/leave mid-run without a restart,
+SIGTERM drains to a boundary checkpoint, and the daemon evicts silent
+workers by lease instead of wedging on them."""
+
+import os
+import signal
+import socket
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu import fleet, telemetry
+from distkeras_tpu.algorithms import AdaptiveBound, make_ctx
+from distkeras_tpu.algorithms.adaptive import BOUND_KEY
+from distkeras_tpu.algorithms.adaptive import AdaptiveDynSGD as AdaptiveRule
+from distkeras_tpu.algorithms.dynsgd import DynSGD as DynRule
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.job_deployment import Job, PunchcardServer
+from distkeras_tpu.models import MLP, FlaxModel
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.configure(True)
+    telemetry.metrics.reset()
+    yield
+    telemetry.metrics.reset()
+    telemetry.configure(None)
+
+
+def _model():
+    return FlaxModel(MLP(features=(16,), num_classes=2))
+
+
+def _df(toy):
+    x, _, onehot = toy
+    return from_numpy(x, onehot)
+
+
+# ------------------------------------------------------- membership table
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_membership_register_heartbeat_deregister():
+    clk = _Clock()
+    fm = fleet.FleetMembership(lease=1.0, miss_tolerance=2, clock=clk)
+    wid = fm.register(workers=4, host="10.0.0.1")
+    assert fm.epoch == 1 and fm.workers_total() == 4
+    # re-register only refreshes the lease; the epoch tracks set changes
+    fm.register(worker_id=wid, workers=4)
+    assert fm.epoch == 1
+    assert fm.heartbeat(wid) is True
+    assert fm.heartbeat("ghost") is False
+    assert fm.deregister(wid) is True
+    assert fm.epoch == 2 and fm.workers_total() == 0
+    assert fm.deregister(wid) is False
+    assert fm.epoch == 2
+
+
+def test_membership_lease_eviction_bumps_epoch_once():
+    clk = _Clock()
+    fm = fleet.FleetMembership(lease=1.0, miss_tolerance=2, clock=clk)
+    a = fm.register(workers=1)
+    b = fm.register(workers=2)
+    assert fm.epoch == 2
+    clk.t = 1.9  # inside lease x tolerance
+    assert fm.sweep() == []
+    clk.t = 2.1
+    assert sorted(fm.sweep()) == sorted([a, b])
+    assert fm.epoch == 3  # one bump for the whole sweep
+    assert fm.evictions == 2 and fm.workers_total() == 0
+
+
+def test_membership_heartbeat_extends_lease():
+    clk = _Clock()
+    fm = fleet.FleetMembership(lease=1.0, miss_tolerance=1, clock=clk)
+    wid = fm.register()
+    clk.t = 0.9
+    assert fm.heartbeat(wid)
+    clk.t = 1.5  # past the original deadline, inside the refreshed one
+    assert fm.sweep() == []
+    clk.t = 2.0
+    assert fm.sweep() == [wid]
+
+
+def test_membership_snapshot_and_validation():
+    fm = fleet.FleetMembership(lease=1.0)
+    fm.register(worker_id="w1", workers=2, host="h1")
+    snap = fm.snapshot()
+    assert snap["epoch"] == 1 and snap["workers_total"] == 2
+    assert snap["members"]["w1"] == {"workers": 2, "host": "h1"}
+    with pytest.raises(ValueError):
+        fleet.FleetMembership(lease=0)
+    with pytest.raises(ValueError):
+        fleet.FleetMembership(miss_tolerance=0)
+
+
+# ------------------------------------------------------- daemon verbs (live)
+
+@pytest.fixture()
+def daemon():
+    server = PunchcardServer(port=0, secret="s3cret", lease=0.15,
+                             lease_misses=1)
+    server.start()
+    yield server
+    server.stop()
+
+
+def _worker(daemon, **kw):
+    return fleet.FleetWorker("127.0.0.1", daemon.port, secret="s3cret", **kw)
+
+
+def test_daemon_register_and_membership_poll(daemon):
+    w1 = _worker(daemon, workers=2)
+    assert w1.register() == 1
+    assert w1.lease == pytest.approx(0.15)
+    assert w1.heartbeat() == 1  # no set change, epoch holds
+
+    poller = fleet.ElasticMembership("127.0.0.1", daemon.port,
+                                     secret="s3cret")
+    assert poller.poll() is None  # baseline read, not a change
+    w2 = _worker(daemon, workers=3)
+    w2.register()
+    assert poller.poll() == 5  # join moved the epoch: new desired count
+    assert poller.poll() is None  # unchanged fleet
+    w1.deregister()
+    assert poller.poll() == 3
+
+
+def test_daemon_lease_eviction_and_metrics(daemon):
+    w = _worker(daemon)
+    w.register()
+    poller = fleet.ElasticMembership("127.0.0.1", daemon.port,
+                                     secret="s3cret")
+    assert poller.poll() is None  # baseline at epoch 1
+    # no heartbeats: the lease (0.15s x 1 miss) expires and either the
+    # runner loop's idle sweep or the membership verb's sweep evicts
+    deadline = time.monotonic() + 10
+    desired = None
+    while desired is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+        desired = poller.poll()
+    assert desired == 1  # workers_total 0, clamped to min_workers
+    with daemon._cv:
+        assert daemon.fleet.evictions == 1
+        assert w.worker_id not in daemon.fleet.members
+    assert telemetry.metrics.counter("fleet_evictions_total").value >= 1
+
+
+def test_fleet_worker_heartbeat_thread_keeps_lease(daemon):
+    w = _worker(daemon, heartbeat_interval=0.04)
+    w.start()
+    try:
+        time.sleep(0.5)  # several full lease windows
+        with daemon._cv:
+            daemon.fleet.sweep()
+            assert w.worker_id in daemon.fleet.members
+    finally:
+        w.stop()
+    with daemon._cv:
+        assert w.worker_id not in daemon.fleet.members  # deregistered
+
+
+def test_fleet_worker_rejoins_after_eviction(daemon):
+    w = _worker(daemon)
+    w.register()
+    with daemon._cv:  # force-evict as the sweeper would
+        del daemon.fleet.members[w.worker_id]
+        daemon.fleet.epoch += 1
+    epoch = w.heartbeat()  # sees "unknown", transparently re-registers
+    assert w.rejoins == 1 and epoch >= 3
+    with daemon._cv:
+        assert w.worker_id in daemon.fleet.members
+
+
+def test_elastic_membership_survives_daemon_outage():
+    poller = fleet.ElasticMembership("127.0.0.1", 1, secret="")
+    assert poller.poll() is None  # unreachable daemon is not a resize
+
+
+def test_wait_timeout_zero_reports_poll_count(daemon):
+    job = Job("127.0.0.1", daemon.port, secret="s3cret",
+              script="print('x')")
+    job.submit()
+    with pytest.raises(TimeoutError, match=r"unpolled"):
+        job.wait(timeout=0)
+    assert job.wait(timeout=30)["status"] == "finished"
+
+
+def test_handler_timeout_frees_the_daemon_thread():
+    server = PunchcardServer(port=0, secret="", handler_timeout=0.2)
+    server.start()
+    try:
+        # half-open client: connects, sends nothing — the handler deadline
+        # must fire instead of wedging the thread forever
+        sock = socket.create_connection(("127.0.0.1", server.port),
+                                        timeout=5)
+        counter = telemetry.metrics.counter("punchcard_handler_timeouts_total")
+        deadline = time.monotonic() + 10
+        while counter.value < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert counter.value >= 1
+        sock.close()
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------- preemption (SIGTERM drain)
+
+def test_preemption_handler_flag_roundtrip():
+    assert fleet.install_preemption_handler() is True
+    assert fleet.install_preemption_handler() is True  # idempotent
+    fleet.reset_preemption()
+    assert not fleet.preemption_requested()
+    os.kill(os.getpid(), signal.SIGTERM)
+    deadline = time.monotonic() + 5
+    while not fleet.preemption_requested() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fleet.preemption_requested()
+    fleet.reset_preemption()
+
+
+def _trainer(ckpt_dir, **kw):
+    kw.setdefault("num_epoch", 3)
+    return dk.DOWNPOUR(_model(), loss="categorical_crossentropy",
+                       worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                       num_workers=4, batch_size=16,
+                       communication_window=4, seed=11,
+                       checkpoint_dir=ckpt_dir, **kw)
+
+
+def test_preemption_drains_to_boundary_checkpoint(toy_classification,
+                                                  tmp_path):
+    df = _df(toy_classification)
+    baseline = _trainer(None).train(df)
+
+    fleet._PREEMPTED.set()  # as if SIGTERM landed mid-epoch
+    try:
+        with pytest.raises(fleet.Preempted, match="drained to the epoch"):
+            _trainer(str(tmp_path)).train(df)
+    finally:
+        fleet.reset_preemption()
+
+    from distkeras_tpu.checkpoint import latest_step
+    assert latest_step(str(tmp_path)) is not None  # boundary save landed
+
+    # a replacement worker resumes from the boundary checkpoint and matches
+    # the uninterrupted run bit-for-bit
+    resumed = _trainer(str(tmp_path), resume=True).train(df)
+    for a, b in zip(jax.tree.leaves(baseline.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_recovery_never_retries_preemption(toy_classification, tmp_path):
+    df = _df(toy_classification)
+    t = _trainer(str(tmp_path))
+    fleet._PREEMPTED.set()
+    try:
+        with pytest.raises(fleet.Preempted):
+            t.train_with_recovery(df)
+    finally:
+        fleet.reset_preemption()
+    assert t.resume is False  # no retry consumed the preemption
+
+
+def test_recovery_backoff_is_capped_exponential(toy_classification,
+                                                 tmp_path, monkeypatch):
+    from distkeras_tpu.parallel.engine import WindowedEngine
+
+    df = _df(toy_classification)
+    real_run_epoch = WindowedEngine.run_epoch
+    calls = {"n": 0}
+
+    def flaky(self, state, xs, ys):
+        calls["n"] += 1
+        if calls["n"] in (2, 4):
+            raise RuntimeError(f"transient #{calls['n']}")
+        return real_run_epoch(self, state, xs, ys)
+
+    monkeypatch.setattr(WindowedEngine, "run_epoch", flaky)
+    delays = []
+    monkeypatch.setattr(time, "sleep", lambda s: delays.append(s))
+    t = _trainer(str(tmp_path))
+    t.train_with_recovery(df, max_retries=3, backoff_base=0.5,
+                          backoff_cap=0.6)
+    # two retries: 0.5 then min(0.6, 1.0), each jittered into [0.5x, 1.0x]
+    assert len(delays) == 2
+    assert 0.25 <= delays[0] <= 0.5
+    assert 0.3 <= delays[1] <= 0.6
+
+
+# ------------------------------------------------------- live elastic resize
+
+class _ScriptedElastic:
+    """Stands in for ElasticMembership: poll() pops a scripted answer."""
+
+    def __init__(self, answers):
+        self.answers = list(answers)
+        self.polls = 0
+
+    def poll(self):
+        self.polls += 1
+        return self.answers.pop(0) if self.answers else None
+
+
+def test_elastic_resize_mid_run(toy_classification, tmp_path):
+    df = _df(toy_classification)
+    ctl = _ScriptedElastic([None, 2])  # epoch 0: unchanged; epoch 1: shrink
+    t = _trainer(str(tmp_path), num_epoch=4, elastic=ctl)
+    trained = t.train(df)
+    assert ctl.polls >= 2  # boundary polling happened
+    for leaf in jax.tree.leaves(trained.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert telemetry.metrics.counter("elastic_resizes_total").value == 1
+    assert telemetry.metrics.gauge("elastic_workers").value == 2
+
+
+def test_elastic_grow_mid_run(toy_classification, tmp_path):
+    df = _df(toy_classification)
+    ctl = _ScriptedElastic([8])
+    t = _trainer(str(tmp_path), num_epoch=3, elastic=ctl)
+    trained = t.train(df)
+    for leaf in jax.tree.leaves(trained.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert telemetry.metrics.gauge("elastic_workers").value == 8
+
+
+def test_elastic_disabled_off_the_per_epoch_loop(toy_classification):
+    df = _df(toy_classification)
+    t = _trainer(None, elastic=_ScriptedElastic([2]), dispatch_epochs=3)
+    with pytest.warns(RuntimeWarning, match="elastic membership polling"):
+        t.train(df)
+
+
+# ------------------------------------------------------- adaptive staleness
+
+def _params(v):
+    return {"w": jnp.asarray(v, jnp.float32)}
+
+
+def test_adaptive_rule_inf_bound_is_dynsgd_bitwise():
+    adaptive, dyn = AdaptiveRule(), DynRule()
+    center, local = _params(0.0), _params(1.0)
+    cst_a = adaptive.init_center_state()
+    cst_d = dyn.init_center_state()
+    cst_a["num_updates"] = cst_d["num_updates"] = jnp.asarray(3, jnp.int32)
+    ra = adaptive.commit(make_ctx(), local, center,
+                         adaptive.init_local_state(center), cst_a)
+    rd = dyn.commit(make_ctx(), local, center,
+                    dyn.init_local_state(center), cst_d)
+    np.testing.assert_array_equal(np.asarray(ra.center_params["w"]),
+                                  np.asarray(rd.center_params["w"]))
+    assert int(ra.center_state["num_updates"]) == int(
+        rd.center_state["num_updates"])
+    assert float(ra.center_state[BOUND_KEY]) == float("inf")
+
+
+def test_adaptive_rule_drops_overbound_commit_but_still_pulls():
+    rule = AdaptiveRule(initial_bound=2.0)
+    center, local = _params(0.0), _params(1.0)
+    cst = rule.init_center_state()
+    cst["num_updates"] = jnp.asarray(5, jnp.int32)  # staleness 5 > bound 2
+    res = rule.commit(make_ctx(), local, center,
+                      rule.init_local_state(center), cst)
+    assert float(res.center_params["w"]) == 0.0  # delta never landed
+    assert int(res.center_state["num_updates"]) == 5  # not counted
+    # graceful catch-up: the dropped worker still adopts the fresh center
+    assert float(res.local_params["w"]) == 0.0
+    assert int(res.local_state["clock"]) == 5
+
+
+def test_adaptive_bound_tightens_on_divergence_spike():
+    p = AdaptiveBound(initial=16.0, min_bound=1.0, max_bound=64.0,
+                      tighten=0.5, loosen=2.0, divergence_factor=2.0)
+    assert p.observe({"divergence_max": 1.0}) == 32.0  # no baseline: loosen
+    assert p.observe({"divergence_max": 1.0}) == 64.0
+    assert p.observe({"divergence_max": 1.0}) == 64.0  # capped
+    assert p.observe({"divergence_max": 10.0}) == 32.0  # spike vs median 1.0
+    assert p.tightened == 1 and p.loosened == 3
+
+
+def test_adaptive_bound_floors_at_observed_staleness():
+    p = AdaptiveBound(initial=2.0, min_bound=1.0, tighten=0.5, loosen=1.0,
+                      divergence_factor=1.5)
+    p.observe({"divergence_max": 1.0})
+    got = p.observe({"divergence_max": 100.0, "rule_staleness_mean": 7.0})
+    assert got == 8.0  # tightened to min_bound, floored at staleness + 1
+
+
+def test_adaptive_trainer_applies_policy_between_epochs(toy_classification):
+    telemetry.dynamics.configure(enabled=True, watchdog="off")
+    try:
+        policy = AdaptiveBound(initial=8.0)
+        t = dk.AdaptiveDynSGD(_model(), loss="categorical_crossentropy",
+                              worker_optimizer=("sgd",
+                                                {"learning_rate": 0.05}),
+                              num_workers=2, batch_size=16, num_epoch=3,
+                              communication_window=2, seed=3,
+                              staleness_policy=policy)
+        trained = t.train(_df(toy_classification))
+        assert policy.tightened + policy.loosened >= 1  # summaries observed
+        assert policy.bound != 8.0  # and the bound actually moved
+        for leaf in jax.tree.leaves(trained.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+        assert telemetry.metrics.gauge(
+            "dynamics_staleness_bound").value == policy.bound
+    finally:
+        telemetry.dynamics.configure()
+
+
+def test_staleness_policy_requires_dynamics(toy_classification):
+    telemetry.dynamics.configure(enabled=False)
+    try:
+        t = dk.AdaptiveDynSGD(_model(), loss="categorical_crossentropy",
+                              worker_optimizer=("sgd",
+                                                {"learning_rate": 0.05}),
+                              num_workers=2, batch_size=16, num_epoch=1,
+                              communication_window=2, seed=3,
+                              staleness_policy=AdaptiveBound())
+        with pytest.warns(RuntimeWarning, match="staleness_policy"):
+            t.train(_df(toy_classification))
+    finally:
+        telemetry.dynamics.configure()

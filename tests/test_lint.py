@@ -414,6 +414,26 @@ def test_dk114_label_disagreement_across_goldens(tmp_path):
     assert "disagree on label keys" in findings[0].message
 
 
+def test_dk115_socket_timeout_fixture():
+    got, _ = _run("dk115_server.py", ["DK115"])
+    assert got == [
+        ("DK115", 10),  # timeout-less create_connection (call site)
+        ("DK115", 30),  # recv on a parameter socket, no settimeout on path
+        ("DK115", 34),  # accept on a parameter listener
+        ("DK115", 35),  # recv on the accept-derived conn (inherits nothing)
+    ]
+
+
+def test_dk115_out_of_scope_module_is_silent(tmp_path):
+    """Same code outside the daemon/server scope stays unflagged — batch
+    code may legitimately block forever."""
+    src = "def f(sock):\n    return sock.recv(16)\n"
+    mod = tmp_path / "batch_tool.py"
+    mod.write_text(src)
+    findings, _ = analyze([str(mod)], root=str(tmp_path), select=["DK115"])
+    assert findings == []
+
+
 # ------------------------------------------------------ interprocedural v2
 
 def test_cross_module_host_sync_found_by_v2():
@@ -531,6 +551,7 @@ def test_all_rules_registered():
     assert sorted(all_rules()) == [
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
         "DK108", "DK109", "DK110", "DK111", "DK112", "DK113", "DK114",
+        "DK115",
     ]
 
 

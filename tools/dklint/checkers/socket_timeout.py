@@ -1,0 +1,180 @@
+"""DK115 — socket operation in a daemon/server module without a deadline.
+
+The control-plane daemon serves every verb on a thread-per-connection
+handler; a ``recv``/``accept``/``connect`` on a socket that carries no
+timeout blocks that thread forever when the peer hangs half-open — the
+slow-loris failure mode the PR-11 handler-deadline fix
+(``conn.settimeout(self.handler_timeout)``) closes at runtime.  This rule
+is its static twin: inside the daemon/server modules it tracks each
+socket's *provenance* through the function's reaching definitions and
+flags blocking calls on sockets that provably lack an applied deadline.
+
+A socket is **bare** (no deadline) when it reaches the call site from:
+
+* a function parameter (the caller's contract is unknown — demand an
+  explicit ``settimeout`` on the path);
+* ``socket.socket(...)`` — constructed blocking by default;
+* ``socket.create_connection(...)`` *without* ``timeout=``;
+* an ``.accept()`` result — accepted sockets do **not** inherit the
+  listener's timeout (CPython fact, commonly assumed otherwise).
+
+It carries a **deadline** when it comes from ``create_connection(...,
+timeout=...)`` or the project helper :func:`distkeras_tpu.networking.
+connect` (which applies a default timeout and leaves it on the returned
+socket).  Any other provenance is unknown and stays silent — this rule
+only fires on provable bareness.  A ``sock.settimeout(...)`` that may
+execute before the blocking call (CFG ``may_follow``) clears the socket.
+
+Timeout-less ``socket.create_connection`` calls are additionally flagged
+at the call site itself (one finding per root cause: sockets derived from
+an already-flagged call are not re-flagged downstream).
+
+Scope: ``networking.py`` / ``job_deployment.py`` / ``fleet.py`` plus any
+module whose basename mentions server/daemon/frontend.  Batch/offline
+code may legitimately block forever; serving threads may not.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Tuple
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.dataflow import Def, FunctionFlow
+from tools.dklint.registry import register
+
+# socket methods that block on the network until the peer acts
+BLOCKING_METHODS = frozenset({"recv", "recv_into", "recvfrom", "accept", "connect"})
+
+_SCOPE_BASENAMES = frozenset({"networking.py", "job_deployment.py", "fleet.py"})
+_SCOPE_MARKERS = ("server", "daemon", "frontend")
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _in_scope(fi: FileInfo) -> bool:
+    base = os.path.basename(fi.relpath)
+    return base in _SCOPE_BASENAMES or any(m in base for m in _SCOPE_MARKERS)
+
+
+def _resolved(fi: FileInfo, node: ast.Call) -> str:
+    """Dotted call name with the head resolved through the import table."""
+    name = call_name(node) or ""
+    head, _, rest = name.partition(".")
+    target = fi.imports.get(head)
+    if target:
+        return target + ("." + rest if rest else "")
+    return name
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    return len(call.args) >= 2  # create_connection(address, timeout)
+
+
+def _classify(fi: FileInfo, d: Def) -> str:
+    """'bare' / 'deadline' / 'unknown' for one reaching definition."""
+    if d.kind == "param":
+        return "bare"
+    value = d.value
+    if not isinstance(value, ast.Call):
+        return "unknown"
+    if isinstance(value.func, ast.Attribute) and value.func.attr == "accept":
+        # accepted sockets never inherit the listener's timeout
+        return "bare"
+    name = _resolved(fi, value)
+    if name == "socket.create_connection":
+        # the timeout-less form is flagged at the call site itself; treat
+        # derived sockets as covered so each root cause fires once
+        return "deadline"
+    if name == "socket.socket":
+        return "bare"
+    if name.rpartition(".")[2] == "connect" and not isinstance(
+            value.func, ast.Attribute):
+        # the project helper (networking.connect) applies a default
+        # deadline and leaves it on the returned socket
+        return "deadline"
+    return "unknown"
+
+
+@register
+class SocketTimeoutChecker(Checker):
+    rule = "DK115"
+    name = "socket-without-deadline"
+    description = (
+        "socket recv/accept/connect in a daemon/server module on a socket "
+        "with no applied timeout (tracked through the socket's provenance)"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        if not _in_scope(fi):
+            return
+        for node in ast.walk(fi.tree):
+            if (
+                isinstance(node, ast.Call)
+                and _resolved(fi, node) == "socket.create_connection"
+                and not _has_timeout(node)
+            ):
+                yield Finding(
+                    path=fi.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.rule,
+                    message=(
+                        "socket.create_connection without timeout= blocks "
+                        "forever on a hung peer — pass a deadline"
+                    ),
+                )
+        for fn in ast.walk(fi.tree):
+            if isinstance(fn, _FN_NODES):
+                yield from self._check_fn(fi, fn)
+
+    def _check_fn(self, fi: FileInfo, fn: ast.AST) -> Iterable[Finding]:
+        nested = set()
+        for child in ast.walk(fn):
+            if child is not fn and isinstance(
+                    child, _FN_NODES + (ast.Lambda,)):
+                nested.update(id(s) for s in ast.walk(child))
+        settimeouts: Dict[str, List[ast.Name]] = {}
+        blocking: List[Tuple[ast.Call, ast.Name, str]] = []
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            recv = node.func.value
+            if not isinstance(recv, ast.Name):
+                # attribute receivers (self._sock.accept()) — conservative
+                # skip: provenance crosses the function boundary
+                continue
+            if node.func.attr == "settimeout":
+                settimeouts.setdefault(recv.id, []).append(recv)
+            elif node.func.attr in BLOCKING_METHODS:
+                blocking.append((node, recv, node.func.attr))
+        if not blocking:
+            return
+        flow = FunctionFlow(fn)
+        for node, recv, attr in blocking:
+            defs = flow.reaching(recv)
+            if not defs:
+                continue  # free variable — provenance unknown, stay silent
+            if not any(_classify(fi, d) == "bare" for d in defs):
+                continue
+            if any(
+                flow.may_follow(s, recv)
+                for s in settimeouts.get(recv.id, ())
+            ):
+                continue
+            yield Finding(
+                path=fi.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                rule=self.rule,
+                message=(
+                    f".{attr}() on '{recv.id}' with no applied deadline — "
+                    "the socket reaches here without a timeout and a hung "
+                    "peer wedges this daemon thread"
+                ),
+            )
