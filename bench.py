@@ -55,6 +55,7 @@ import os
 import statistics
 import threading
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -1327,6 +1328,121 @@ def run_serving(n_requests: int = 64, num_slots: int = 8, page_size: int = 16,
     return row
 
 
+def run_serving_tier(n_requests: int = 48, replicas: int = 3,
+                     num_slots: int = 4, page_size: int = 16,
+                     max_new_tokens: int = 24, dim: int = 256, heads: int = 8,
+                     num_layers: int = 4, max_len: int = 256,
+                     vocab: int = 4096,
+                     concurrency: Optional[int] = None) -> dict:
+    """Router-level scaling row: the same closed-loop offered load as
+    ``run_serving``, but through :class:`distkeras_tpu.serving.ServingTier`
+    fronting ``replicas`` in-process engines (health-gated least-loaded
+    dispatch, failover retry, deadline propagation).  The value is
+    end-to-end generated tokens/sec through the router; each replica's
+    engine matches the single-engine row's shape, so value divided by that
+    row's value is the tier's scaling efficiency.  Chaos folds in
+    transparently — run under ``DISTKERAS_CHAOS`` with a ``kill_replica``
+    spec and the row's failover/shed counters quantify the recovery cost
+    (every admitted request still completes, bit-equal, via failover)."""
+    import jax
+
+    from distkeras_tpu.models.transformer import TransformerLM
+    from distkeras_tpu.serving import (
+        GenerateRequest,
+        ServingEngine,
+        ServingTier,
+        TierError,
+    )
+    from distkeras_tpu.telemetry.metrics import Registry
+
+    model = TransformerLM(vocab_size=vocab, dim=dim, heads=heads,
+                          num_layers=num_layers, max_len=max_len)
+    rng = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))["params"]
+    engines = [ServingEngine(model, params, num_slots=num_slots,
+                             page_size=page_size, queue_size=num_slots * 4,
+                             registry=Registry())
+               for _ in range(replicas)]
+    registry = Registry()  # tier-level counters, private to the bench
+    tier = ServingTier(engines, probe_interval=0.05, probe_timeout=2.0,
+                       default_deadline_s=300.0, registry=registry)
+    tier.start()
+    prompts = [rng.randint(0, vocab, size=int(n)).tolist()
+               for n in rng.randint(4, max_len - max_new_tokens,
+                                    size=n_requests)]
+    # warmup: compile every replica's prefill buckets + decode program
+    # outside the timed region (engines share shapes but not jit caches)
+    for eng in engines:
+        for w in eng.prefill_buckets:
+            eng.generate(rng.randint(0, vocab, size=w - 2).tolist(),
+                         max_new_tokens=2, timeout=300.0)
+
+    results: list = [None] * len(prompts)
+    errors: list = []
+    lock = threading.Lock()
+    cursor = iter(range(len(prompts)))
+
+    def worker():
+        while True:
+            with lock:
+                i = next(cursor, None)
+            if i is None:
+                return
+            req = GenerateRequest(prompt=prompts[i],
+                                  max_new_tokens=max_new_tokens)
+            try:
+                results[i] = tier.dispatch(req, deadline_s=300.0)
+            except TierError as e:  # shed/deadline: counted, not fatal
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+    conc = concurrency or replicas * num_slots
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(conc)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    tier.stop(close_replicas=True)
+    done = [r for r in results if r is not None]
+    total_tokens = sum(len(r.tokens) for r in done)
+    snap = registry.snapshot()
+
+    def _ctr(name):
+        entry = snap.get(name)
+        return 0 if entry is None else entry.get("value", 0)
+
+    lats = sorted(r.latency_s for r in done)
+
+    def q(frac):
+        if not lats:
+            return None
+        return round(lats[min(len(lats) - 1, int(frac * len(lats)))], 4)
+
+    return {
+        "metric": "serving_tier_tokens_per_sec",
+        "value": round(total_tokens / wall, 1) if wall > 0 else None,
+        "unit": "generated tokens/sec through the replica router",
+        "vs_baseline": None,
+        "replicas": replicas,
+        "num_slots": num_slots,
+        "requests": len(done),
+        "dropped": len(prompts) - len(done),
+        "failovers": _ctr("serving_tier_failovers_total"),
+        "hedges": _ctr("serving_tier_hedges_total"),
+        "sheds": _ctr("serving_tier_sheds_total"),
+        "deadline_expired": _ctr("serving_tier_deadline_expired_total"),
+        "request_latency_p50_s": q(0.50),
+        "request_latency_p99_s": q(0.99),
+        "protocol": f"closed loop, {conc} concurrent callers, mixed prompt "
+                    "lengths, greedy sampling; warmup compile excluded"
+                    + (f"; errors={errors[:3]}" if errors else ""),
+    }
+
+
 def run_datapipe(n: int = 8192, feature_dim: int = 64, batch: int = 64,
                  window: int = 4, num_workers: int = 8, k: int = 3,
                  reps: int = 3) -> list:
@@ -1443,6 +1559,11 @@ def main():
     parser.add_argument("--mfu-ceiling", action="store_true",
                         help="append a measured per-layer-roofline MFU-ceiling "
                         "line per requested config")
+    parser.add_argument("--serving-tier", action="store_true",
+                        help="append a replica-router scaling line: the "
+                             "serving workload dispatched through a "
+                             "3-replica ServingTier (failover, deadline "
+                             "propagation, least-loaded routing)")
     parser.add_argument("--serving", action="store_true",
                         help="append an online-serving SLO line (continuous "
                         "batching tokens/sec + TTFT/latency quantiles)")
@@ -1519,6 +1640,8 @@ def main():
     if args.serving:
         pending.append("serving_tokens_per_sec")
         pending.append("serving_spec_tokens_per_sec")
+    if args.serving_tier:
+        pending.append("serving_tier_tokens_per_sec")
 
     if not args.distributed and not args.cpu:
         if ensure_backend(pending) is None:
@@ -1691,6 +1814,23 @@ def main():
             deadman.disarm()
             _emit_error(f"{type(e).__name__}: {e}",
                         metric="serving_spec_tokens_per_sec")
+        finally:
+            deadman.disarm()
+        if line is not None:
+            emit(line)
+        pending.pop(0)
+
+    if args.serving_tier:
+        # router row: the serving workload again, but through a 3-replica
+        # ServingTier — value / serving row value = tier scaling efficiency
+        deadman.arm(args.config_timeout, pending)
+        line = None
+        try:
+            line = _ok_line(run_serving_tier())
+        except Exception as e:  # noqa: BLE001 — one JSON line, always
+            deadman.disarm()
+            _emit_error(f"{type(e).__name__}: {e}",
+                        metric="serving_tier_tokens_per_sec")
         finally:
             deadman.disarm()
         if line is not None:

@@ -24,6 +24,11 @@ makes recovery paths provable in CI rather than asserted.
 ``tear_send=K``         first K ``send`` sites put a truncated frame on the
                         wire (seeded split point) then raise ConnectionError
 ``delay_send_ms=M``     every ``send`` site sleeps M milliseconds first
+``kill_replica=N``      raise :class:`ChaosKilled` at the Nth ``replica``
+                        site (a serving replica's decode loop, hit only
+                        while requests are in flight; fires once)
+``stall_http=K``        first K ``http`` sites (health probes) sleep
+                        ``stall_secs`` — a wedged ``/healthz``
 ======================  =====================================================
 
 Example: ``DISTKERAS_CHAOS=7:kill_block=5,refuse_connect=2``.
@@ -59,6 +64,7 @@ _FALSEY = ("", "0", "false", "no")
 _INT_KEYS = frozenset({
     "kill_epoch", "kill_block", "stall_block", "refuse_connect",
     "drop_reply", "drop_recv", "tear_send", "delay_send_ms",
+    "kill_replica", "stall_http",
 })
 _FLOAT_KEYS = frozenset({"stall_secs"})
 
@@ -182,7 +188,7 @@ def _note(kind: str) -> None:
 def fault(site: str) -> None:
     """Fire any armed fault for ``site``; no-op (beyond one counter bump)
     otherwise.  Sites: ``connect``, ``send``, ``recv``, ``rpc_reply``,
-    ``epoch``, ``block``."""
+    ``epoch``, ``block``, ``replica``, ``http``."""
     cfg = spec()
     if cfg is None:
         return
@@ -207,7 +213,7 @@ def fault(site: str) -> None:
         delay = cfg.get("delay_send_ms")
         if delay:
             _note("delay_send")
-            time.sleep(delay / 1000.0)
+            time.sleep(delay / 1000.0)  # dklint: disable=DK112 — injected stall
     elif site == "epoch":
         k = cfg.get("kill_epoch")
         if k is not None and n == k and _fire_once("kill_epoch"):
@@ -221,7 +227,18 @@ def fault(site: str) -> None:
         k = cfg.get("stall_block")
         if k is not None and n == k and _fire_once("stall_block"):
             _note("stall_block")
-            time.sleep(cfg.get("stall_secs") or 0.05)
+            time.sleep(cfg.get("stall_secs") or 0.05)  # dklint: disable=DK112 — injected stall
+    elif site == "replica":
+        k = cfg.get("kill_replica")
+        if k is not None and n == k and _fire_once("kill_replica"):
+            _note("kill_replica")
+            raise ChaosKilled(
+                f"chaos: serving replica killed at busy iteration {n}")
+    elif site == "http":
+        k = cfg.get("stall_http")
+        if k is not None and n < k:
+            _note("stall_http")
+            time.sleep(cfg.get("stall_secs") or 0.05)  # dklint: disable=DK112 — injected stall
 
 
 def tear_bytes(site: str, frame_len: int) -> Optional[int]:

@@ -27,7 +27,7 @@ from distkeras_tpu import telemetry
 __all__ = [
     "save_checkpoint", "restore_checkpoint", "restore_center",
     "model_state_worker_mean", "latest_step",
-    "checkpoint_num_workers", "CheckpointManager",
+    "checkpoint_num_workers", "CheckpointManager", "CheckpointWatcher",
     "save_data_state", "restore_data_state",
 ]
 
@@ -151,6 +151,40 @@ def latest_step(directory: str) -> Optional[int]:
     wait_until_finished()  # a step only counts once its async save committed
     steps = committed_steps(directory)
     return steps[-1] if steps else None
+
+
+class CheckpointWatcher:
+    """Newest-step watcher over a checkpoint directory — the train→serve
+    bridge.  ``poll()`` returns the newest committed step the first time it
+    is seen, ``None`` otherwise.
+
+    Built on :func:`committed_steps` (directory listing = commit record),
+    NOT :func:`latest_step`: the latter flushes *this* process's async save
+    queue, which is meaningless — and wrong to wait on — when the trainer
+    writing the checkpoints is a different process.  With ``start_after``
+    omitted, the watcher baselines at the newest step already on disk at
+    construction, so only steps committed *afterwards* fire (a serving
+    replica that just loaded step N must not be told to hot-swap to step
+    N).  Pass ``start_after=-1`` to see every committed step including
+    pre-existing ones."""
+
+    def __init__(self, directory: str,
+                 start_after: Optional[int] = None):
+        self.directory = directory
+        if start_after is None:
+            steps = committed_steps(directory)
+            start_after = steps[-1] if steps else -1
+        self.last_step = int(start_after)
+
+    def poll(self) -> Optional[int]:
+        """The newest committed step if it is newer than anything reported
+        before, else ``None``.  Intermediate steps are skipped on purpose:
+        a serving fleet swaps to the freshest params, not through history."""
+        steps = committed_steps(self.directory)
+        if steps and steps[-1] > self.last_step:
+            self.last_step = steps[-1]
+            return self.last_step
+        return None
 
 
 def restore_checkpoint(directory: str, step: Optional[int] = None, like: Any = None) -> Any:

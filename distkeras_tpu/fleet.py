@@ -112,15 +112,19 @@ class FleetMembership:
         return self._clock() + self.lease * self.miss_tolerance
 
     def register(self, worker_id: Optional[str] = None, workers: int = 1,
-                 host: Optional[str] = None) -> str:
+                 host: Optional[str] = None,
+                 meta: Optional[dict] = None) -> str:
         """Join (or re-join) the fleet; returns the worker id.  A re-register
         of a live member only refreshes its lease — the epoch moves only
-        when the member set actually changes."""
+        when the member set actually changes.  ``meta`` is an opaque
+        JSON-safe dict carried through to :meth:`snapshot` (the serving
+        tier tags replicas with their role/index here)."""
         wid = worker_id or uuid.uuid4().hex
         fresh = wid not in self.members
         self.members[wid] = {
             "workers": int(workers),
             "host": host,
+            "meta": dict(meta) if meta else {},
             "deadline": self._deadline(),
         }
         if fresh:
@@ -166,7 +170,8 @@ class FleetMembership:
             "workers_total": self.workers_total(),
             "evictions": self.evictions,
             "members": {
-                wid: {"workers": m["workers"], "host": m["host"]}
+                wid: {"workers": m["workers"], "host": m["host"],
+                      **({"meta": m["meta"]} if m.get("meta") else {})}
                 for wid, m in self.members.items()
             },
         }

@@ -108,6 +108,10 @@ class PunchcardServer:
         self._idempotent: Dict[str, dict] = {}
         self._idempotent_order: list[str] = []
         self._evictions_exported = 0
+        # serve_tier replica groups: tier_id -> {script, args, flags,
+        # job_ids, respawns, max_respawns}.  Mutated under the cv; the
+        # runner loop's idle wakeups double as the respawn supervisor.
+        self._tiers: Dict[str, dict] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -218,45 +222,87 @@ class PunchcardServer:
                 if cached is not None:
                     send_data(conn, cached)
                     return
-                job_id = uuid.uuid4().hex
-                script_path = os.path.join(self.workdir, f"{job_id}.py")
-                with open(script_path, "w") as f:
-                    f.write(msg["script"])
                 flags = msg.get("flags")
-                job = {"status": "serving", "output": "", "returncode": None,
-                       "metrics": None, "script": msg["script"],
-                       "args": msg.get("args", []), "log_path": None,
-                       "serve_flags": flags if isinstance(flags, dict) else {}}
-                env, tel_dir = self._job_env(job_id, ensure_http=True)
-                if tel_dir is not None:
-                    job["telemetry_dir"] = tel_dir
-                if job["serve_flags"]:
-                    # engine knobs (prefill_buckets, spec_tokens, ...) ride
-                    # to the child as JSON; the script reads them back via
-                    # serving.serve_flags() so one script serves many configs
-                    if env is None:  # telemetry off: _job_env built no env
-                        env = dict(os.environ)
-                    env["DISTKERAS_SERVE_FLAGS"] = json.dumps(job["serve_flags"])
-                log_path = os.path.join(self.workdir, f"{job_id}.log")
-                job["log_path"] = log_path
-                with open(log_path, "w") as log:
-                    proc = subprocess.Popen(
-                        [sys.executable, script_path, *map(str, job["args"])],
-                        stdout=log, stderr=subprocess.STDOUT,
-                        cwd=self.workdir, env=env,
-                    )
+                job_id = self._spawn_serve_job(
+                    msg["script"], list(msg.get("args", [])),
+                    flags if isinstance(flags, dict) else {})
                 reply = {"status": "serving", "job_id": job_id}
                 with self._cv:
-                    self.jobs[job_id] = job
-                    self._serving[job_id] = proc
                     self._remember(idem, reply)
-                    n_serving = len(self._serving)
-                if telemetry.enabled():
-                    telemetry.metrics.gauge(
-                        "punchcard_serving_jobs",
-                        help="serve-verb engines currently hosted",
-                    ).set(n_serving)
                 send_data(conn, reply)
+            elif action == "serve_tier":
+                # N identical serving replicas as one supervised group —
+                # the unit the ServingTier router fronts.  Each replica is
+                # an ordinary serve job (own exporter, own log, own
+                # job_id); the daemon tracks the group so tier_status
+                # answers in one round trip and the runner loop's idle
+                # wakeups respawn crashed replicas (capped per tier).
+                with self._cv:
+                    cached = self._idempotent.get(idem) if idem else None
+                if cached is not None:
+                    send_data(conn, cached)
+                    return
+                replicas = max(1, int(msg.get("replicas") or 1))
+                flags = msg.get("flags")
+                flags = dict(flags) if isinstance(flags, dict) else {}
+                tier_id = uuid.uuid4().hex
+                job_ids = [
+                    self._spawn_serve_job(
+                        msg["script"], list(msg.get("args", [])), flags,
+                        extra_env={"DISTKERAS_TIER_ID": tier_id,
+                                   "DISTKERAS_REPLICA_INDEX": str(i)})
+                    for i in range(replicas)
+                ]
+                reply = {"status": "serving", "tier_id": tier_id,
+                         "job_ids": list(job_ids)}
+                with self._cv:
+                    self._tiers[tier_id] = {
+                        "script": msg["script"],
+                        "args": list(msg.get("args", [])),
+                        "flags": flags,
+                        "job_ids": job_ids,
+                        "respawns": 0,
+                        "max_respawns": int(msg.get("max_respawns", 3)),
+                    }
+                    self._remember(idem, reply)
+                send_data(conn, reply)
+            elif action == "tier_status":
+                with self._cv:
+                    tier = self._tiers.get(msg.get("tier_id", ""))
+                    job_ids = list(tier["job_ids"]) if tier else []
+                if tier is None:
+                    send_data(conn, {"status": "unknown"})
+                else:
+                    reps = []
+                    for jid in job_ids:
+                        job = self.jobs.get(jid)
+                        if job is None:
+                            continue
+                        self._refresh_serving(jid, job)
+                        reps.append({"job_id": jid,
+                                     "status": job["status"],
+                                     "http": self._job_http_address(job)})
+                    with self._cv:
+                        respawns = tier["respawns"]
+                        cap = tier["max_respawns"]
+                    send_data(conn, {
+                        "status": "ok", "tier_id": msg.get("tier_id"),
+                        "replicas": reps,
+                        "serving": sum(1 for r in reps
+                                       if r["status"] == "serving"),
+                        "respawns": respawns, "max_respawns": cap})
+            elif action == "stop_tier":
+                with self._cv:
+                    tier = self._tiers.pop(msg.get("tier_id", ""), None)
+                    job_ids = list(tier["job_ids"]) if tier else []
+                if tier is None:
+                    send_data(conn, {"status": "unknown"})
+                else:
+                    stopped = sum(1 for jid in job_ids
+                                  if self._stop_serving_job(jid))
+                    send_data(conn, {"status": "stopped",
+                                     "tier_id": msg.get("tier_id"),
+                                     "stopped": stopped})
             elif action == "stop_serving":
                 job_id = msg.get("job_id", "")
                 if self._stop_serving_job(job_id):
@@ -399,6 +445,112 @@ class PunchcardServer:
             env["DISTKERAS_TELEMETRY_HTTP"] = "0"
         return env, tel_dir
 
+    def _spawn_serve_job(self, script: str, args: list, flags: dict,
+                         extra_env: Optional[Dict[str, str]] = None) -> str:
+        """Spawn one detached serving process (shared by the ``serve`` and
+        ``serve_tier`` verbs and the tier respawn supervisor): write the
+        script, build the job env with the exporter forced on (the
+        ``/generate`` endpoint lives on it), Popen with a log file, record
+        the job and its process under the cv.  Returns the new job_id."""
+        job_id = uuid.uuid4().hex
+        script_path = os.path.join(self.workdir, f"{job_id}.py")
+        with open(script_path, "w") as f:
+            f.write(script)
+        job = {"status": "serving", "output": "", "returncode": None,
+               "metrics": None, "script": script, "args": list(args),
+               "log_path": None, "serve_flags": dict(flags)}
+        env, tel_dir = self._job_env(job_id, ensure_http=True)
+        if tel_dir is not None:
+            job["telemetry_dir"] = tel_dir
+        if job["serve_flags"] or extra_env:
+            if env is None:  # telemetry off: _job_env built no env
+                env = dict(os.environ)
+            if job["serve_flags"]:
+                # engine knobs (prefill_buckets, spec_tokens, ...) ride to
+                # the child as JSON; the script reads them back via
+                # serving.serve_flags() so one script serves many configs
+                env["DISTKERAS_SERVE_FLAGS"] = json.dumps(job["serve_flags"])
+            if extra_env:
+                env.update(extra_env)
+        log_path = os.path.join(self.workdir, f"{job_id}.log")
+        job["log_path"] = log_path
+        with open(log_path, "w") as log:
+            proc = subprocess.Popen(
+                [sys.executable, script_path, *map(str, args)],
+                stdout=log, stderr=subprocess.STDOUT,
+                cwd=self.workdir, env=env,
+            )
+        with self._cv:
+            self.jobs[job_id] = job
+            self._serving[job_id] = proc
+            n_serving = len(self._serving)
+        if telemetry.enabled():
+            telemetry.metrics.gauge(
+                "punchcard_serving_jobs",
+                help="serve-verb engines currently hosted",
+            ).set(n_serving)
+        return job_id
+
+    def _find_dead_replica(self) -> Optional[tuple]:
+        """One crashed tier replica due a respawn, as ``(tier_id, job_id)``
+        — or ``None``.  Caller holds the cv; ``poll()`` is a non-blocking
+        reap.  Tiers out of respawn credits are skipped: their dead
+        replicas stay visible through ``tier_status`` as failed instead of
+        flapping forever."""
+        for tier_id, tier in self._tiers.items():
+            if tier["respawns"] >= tier["max_respawns"]:
+                continue
+            for jid in tier["job_ids"]:
+                proc = self._serving.get(jid)
+                if proc is not None and proc.poll() is not None:
+                    return tier_id, jid
+                if proc is None:
+                    # a tier_status poll's _refresh_serving may reap the
+                    # corpse first — the folded status is still a death
+                    # ("stopped" is an explicit stop, never respawned)
+                    job = self.jobs.get(jid) or {}
+                    if job.get("status") in ("failed", "finished"):
+                        return tier_id, jid
+        return None
+
+    def _respawn_replica(self, tier_id: str, dead_id: str) -> None:
+        """Replace one crashed tier replica: fold the dead process into its
+        job record (off-lock log read), burn one respawn credit, spawn the
+        replacement into the same slot.  Runs on the runner thread."""
+        job = self.jobs.get(dead_id)
+        if job is not None:
+            self._refresh_serving(dead_id, job)
+        with self._cv:
+            tier = self._tiers.get(tier_id)
+            if (tier is None or dead_id not in tier["job_ids"]
+                    or tier["respawns"] >= tier["max_respawns"]):
+                return  # tier stopped / already handled / out of credits
+            tier["respawns"] += 1
+            index = tier["job_ids"].index(dead_id)
+            script = tier["script"]
+            args = list(tier["args"])
+            flags = dict(tier["flags"])
+        new_id = self._spawn_serve_job(
+            script, args, flags,
+            extra_env={"DISTKERAS_TIER_ID": tier_id,
+                       "DISTKERAS_REPLICA_INDEX": str(index)})
+        with self._cv:
+            tier = self._tiers.get(tier_id)
+            live = (tier is not None and index < len(tier["job_ids"])
+                    and tier["job_ids"][index] == dead_id)
+            if live:
+                tier["job_ids"][index] = new_id
+        if not live:
+            # the tier was stopped while the replacement was starting —
+            # reap the orphan instead of leaking a headless engine
+            self._stop_serving_job(new_id)
+            return
+        if telemetry.enabled():
+            telemetry.metrics.counter(
+                "punchcard_tier_respawns_total",
+                help="tier serve replicas respawned after a crash",
+            ).inc()
+
     def _refresh_serving(self, job_id: str, job: dict) -> None:
         """Fold a serve job's process state into its status: a serving
         engine that exited did not finish — it died (or was stopped).
@@ -458,23 +610,34 @@ class PunchcardServer:
 
     def _runner_loop(self) -> None:
         while True:
+            respawn = None
             with self._cv:
                 while self._running and not self._queue:
                     self._cv.wait(timeout=0.5)
                     # the runner's idle wakeups double as the lease sweeper:
                     # an expired worker is evicted (and the membership epoch
-                    # bumped) within ~0.5 s even with no verb traffic
+                    # bumped) within ~0.5 s even with no verb traffic ...
                     if self.fleet.sweep():
                         self._export_fleet_metrics()
+                    # ... and as the tier supervisor: a crashed serve_tier
+                    # replica is detected here and respawned off-lock below
+                    respawn = self._find_dead_replica()
+                    if respawn is not None:
+                        break
                 if not self._running:
                     return
-                job_id = self._queue.pop(0)
-                # job lookup + status flip under the cv (previously both
-                # raced the handler threads from outside the lock)
-                job = self.jobs[job_id]
-                job["status"] = "running"
-                script = job["script"]
-                args = list(job["args"])
+                if respawn is None:
+                    job_id = self._queue.pop(0)
+                    # job lookup + status flip under the cv (previously both
+                    # raced the handler threads from outside the lock)
+                    job = self.jobs[job_id]
+                    job["status"] = "running"
+                    script = job["script"]
+                    args = list(job["args"])
+            if respawn is not None:
+                # the spawn itself (log open + Popen) must not hold the cv
+                self._respawn_replica(*respawn)
+                continue
             script_path = os.path.join(self.workdir, f"{job_id}.py")
             with open(script_path, "w") as f:
                 f.write(script)
@@ -622,6 +785,7 @@ class Job:
         self.script = script
         self.args = args or []
         self.job_id: Optional[str] = None
+        self.tier_id: Optional[str] = None
         #: socket deadline per RPC attempt (connect + send + recv)
         self.rpc_timeout = rpc_timeout
         #: transport-failure retries per RPC (0 = single attempt)
@@ -728,6 +892,62 @@ class Job:
         raise TimeoutError(
             f"serving job {self.job_id} published no address after {polls} "
             f"poll(s) in {timeout}s")
+
+    def serve_tier(self, replicas: int, flags: Optional[dict] = None,
+                   max_respawns: int = 3) -> str:
+        """Host ``replicas`` copies of this client's script as one
+        supervised serving tier (``serve_tier`` verb).  Each replica is an
+        ordinary serve job; the daemon respawns crashed replicas (up to
+        ``max_respawns`` across the tier) from its runner loop's idle
+        wakeups.  Returns the tier id (also stored on ``self.tier_id``);
+        front the replicas with :class:`distkeras_tpu.serving.ServingTier`
+        over :class:`~distkeras_tpu.serving.HttpReplica` handles built from
+        :meth:`tier_addresses`."""
+        msg = {"action": "serve_tier", "script": self.script,
+               "args": self.args, "replicas": int(replicas),
+               "max_respawns": int(max_respawns),
+               "idempotency": uuid.uuid4().hex}
+        if flags is not None:
+            msg["flags"] = dict(flags)
+        reply = self._rpc(msg)
+        if reply.get("status") != "serving":
+            raise RuntimeError(f"serve_tier rejected: {reply}")
+        self.tier_id = reply["tier_id"]
+        return self.tier_id
+
+    def tier_status(self, tier_id: Optional[str] = None) -> dict:
+        """Per-replica status of a serving tier (``tier_status`` verb):
+        ``{"status": "ok", "replicas": [{"job_id", "status", "http"}, ...],
+        "serving": N, "respawns": n, "max_respawns": cap}``."""
+        tid = tier_id or self.tier_id
+        if tid is None:
+            raise RuntimeError("no tier to query")
+        return self._rpc({"action": "tier_status", "tier_id": tid})
+
+    def stop_tier(self, tier_id: Optional[str] = None) -> dict:
+        """Terminate every replica of a serving tier (``stop_tier`` verb);
+        defaults to this client's tier."""
+        tid = tier_id or self.tier_id
+        if tid is None:
+            raise RuntimeError("no tier to stop")
+        return self._rpc({"action": "stop_tier", "tier_id": tid})
+
+    def tier_addresses(self, timeout: float = 30.0,
+                       poll: float = 0.2) -> list:
+        """Block until every tier replica has published its flightdeck
+        address; returns ``["host:port", ...]`` ordered by replica slot."""
+        deadline = time.monotonic() + timeout
+        st: dict = {}
+        while time.monotonic() < deadline:
+            st = self.tier_status()
+            reps = st.get("replicas", [])
+            if reps and all(r.get("status") == "serving" and r.get("http")
+                            for r in reps):
+                return [r["http"] for r in reps]
+            time.sleep(poll)
+        raise TimeoutError(
+            f"tier {self.tier_id} not fully addressable after {timeout}s: "
+            f"{st}")
 
     def metrics(self, job_id: Optional[str] = None) -> dict:
         """Scrape the daemon's telemetry registry (``metrics`` verb):

@@ -11,7 +11,10 @@ a service that admits requests whenever they arrive:
 * :mod:`~distkeras_tpu.serving.sampling` — temperature / top-k / top-p
   with per-request seeds, all traced (no recompiles);
 * :mod:`~distkeras_tpu.serving.frontend` — request/response dataclasses,
-  bounded queue with backpressure, the flightdeck ``/generate`` endpoint.
+  bounded queue with backpressure, the flightdeck ``/generate`` endpoint;
+* :mod:`~distkeras_tpu.serving.tier` — the fault-tolerant router over N
+  replicas: health-gated least-loaded dispatch, failover retry, deadline
+  propagation, load shedding, rolling checkpoint hot-swap.
 
 Serve over HTTP (flightdeck exporter carries the endpoint)::
 
@@ -32,7 +35,7 @@ decode over the local devices.
 """
 
 from distkeras_tpu.serving.cache import PagedKVCache, append_rows, rollback_rows
-from distkeras_tpu.serving.engine import ServingEngine, serving_metrics
+from distkeras_tpu.serving.engine import EngineCrashed, ServingEngine, serving_metrics
 from distkeras_tpu.serving.frontend import (
     GenerateRequest,
     GenerateResult,
@@ -47,16 +50,39 @@ from distkeras_tpu.serving.sampling import (
     sample_tokens,
     speculative_verify,
 )
+from distkeras_tpu.serving.tier import (
+    HttpReplica,
+    LocalReplica,
+    ReplicaDead,
+    ServingTier,
+    TierDeadline,
+    TierError,
+    TierExhausted,
+    TierSaturated,
+    install_tier_endpoint,
+    tier_metrics,
+    watch_and_swap,
+)
 
 __all__ = [
+    "EngineCrashed",
     "GenerateRequest",
     "GenerateResult",
+    "HttpReplica",
+    "LocalReplica",
     "PagedKVCache",
     "QueueFull",
+    "ReplicaDead",
     "RequestQueue",
     "ServingEngine",
+    "ServingTier",
+    "TierDeadline",
+    "TierError",
+    "TierExhausted",
+    "TierSaturated",
     "append_rows",
     "install_http_endpoint",
+    "install_tier_endpoint",
     "modified_probs",
     "rollback_rows",
     "sample_one",
@@ -64,4 +90,6 @@ __all__ = [
     "serve_flags",
     "serving_metrics",
     "speculative_verify",
+    "tier_metrics",
+    "watch_and_swap",
 ]

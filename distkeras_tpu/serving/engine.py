@@ -76,6 +76,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import chaos as _chaos
 from distkeras_tpu.sanitizer import lockwatch
 from distkeras_tpu.serving.cache import PagedKVCache, append_rows, rollback_rows
 from distkeras_tpu.serving.frontend import (
@@ -90,7 +91,13 @@ from distkeras_tpu.serving.sampling import (
     speculative_verify_tokens,
 )
 
-__all__ = ["ServingEngine", "serving_metrics"]
+__all__ = ["EngineCrashed", "ServingEngine", "serving_metrics"]
+
+
+class EngineCrashed(RuntimeError):
+    """The engine's host loop died (chaos ``kill_replica`` or an equivalent
+    hard fault): every request aborted, the replica is dead.  Raised by
+    ``submit``/``hot_swap`` so a router can tell "dead" from "saturated"."""
 
 
 def serving_metrics(registry=None) -> dict:
@@ -147,6 +154,10 @@ def serving_metrics(registry=None) -> dict:
         "spec_accepted": registry.counter(
             "serving_spec_accepted_total",
             help="draft tokens accepted by target verification",
+        ),
+        "hot_swaps": registry.counter(
+            "serving_hot_swaps_total",
+            help="in-place param hot-swaps applied by this engine",
         ),
     }
 
@@ -439,6 +450,13 @@ class ServingEngine:
         self._cv = lockwatch.maybe_wrap(threading.Condition(), "serving.engine")
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # tier hooks: drain/hot-swap/cancel state, all owned by the loop
+        # thread except the flags themselves (set under _cv by callers)
+        self._crashed = False
+        self._draining = False
+        self._drain_ack = False
+        self._swap: Optional[Tuple[_Spec, threading.Event]] = None
+        self._cancelled: List[_Pending] = []
 
         # Programs compile once per (engine, mesh) config — never per
         # request (the retrace pin in tests/test_serving.py counts on it):
@@ -775,6 +793,8 @@ class ServingEngine:
         """Validate + enqueue; returns a :class:`_Pending` handle.  Raises
         :class:`~distkeras_tpu.serving.frontend.QueueFull` under
         backpressure and ``ValueError`` for an unservable request."""
+        if self._crashed:
+            raise EngineCrashed("serving engine crashed; replica is dead")
         request.validate()
         plen = len(request.prompt)
         if plen > self._width or plen >= self._spec.max_len:
@@ -822,7 +842,117 @@ class ServingEngine:
             "active_slots": float(int(self._active.sum())),
             "pages_in_use": float(self._cache.pages_in_use),
             "pages_free": float(self._cache.pages_free),
+            "slots_total": float(self.num_slots),
         }
+
+    @property
+    def alive(self) -> bool:
+        """``False`` once the loop has crashed — the health probe's fast
+        path for telling "this replica is dead" from "this replica is slow"."""
+        return not self._crashed
+
+    @property
+    def draining(self) -> bool:
+        """Whether admission is paused (explicit :meth:`drain` or an
+        in-flight :meth:`hot_swap`)."""
+        return self._draining or self._swap is not None
+
+    # ------------------------------------------------- tier hooks (host side)
+
+    def cancel(self, pending: _Pending) -> bool:
+        """Abort a submitted request: queued — removed and resolved
+        ``"aborted"`` immediately; in a slot — retired ``"aborted"`` at the
+        loop's next iteration (slot and pages reclaimed).  Returns ``False``
+        when the request had already finished.  This is what makes a 504 a
+        *release* instead of a leak, and what makes router failover
+        idempotent: once the cancelled handle resolves, this engine is
+        provably no longer executing the request."""
+        if pending.done():
+            return False
+        if self._queue.remove(pending):
+            self._finish(pending, [], "aborted", 0.0)
+            self._metrics["queue_depth"].set(len(self._queue))
+            return True
+        with self._cv:
+            running = self._running
+            if running:
+                self._cancelled.append(pending)
+                self._cv.notify_all()
+        if not running and not pending.done():
+            # no loop to process it (engine stopped or never started with
+            # the handle outside the queue) — resolve it directly
+            self._finish(pending, [], "aborted", 0.0)
+        return True
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Pause admission and wait until every occupied slot retires.
+        Queued requests stay queued (they admit again after
+        :meth:`resume`).  Returns ``True`` once drained; ``False`` on
+        timeout (admission stays paused either way)."""
+        with self._cv:
+            self._draining = True
+            started = self._thread is not None
+            self._cv.notify_all()
+        if not started:
+            return True  # no loop ⇒ nothing in flight, nothing can admit
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            if not self._running:
+                return True  # stopped/crashed under us — slots are clear
+            if self._drain_ack and not self._active.any():
+                return True
+            time.sleep(0.002)
+        return False
+
+    def resume(self) -> None:
+        """Reopen admission after :meth:`drain`."""
+        with self._cv:
+            self._draining = False
+            self._drain_ack = False
+            self._cv.notify_all()
+
+    def hot_swap(self, model, params=None, timeout: float = 30.0) -> None:
+        """Swap the served params in place — the checkpoint hot-swap.
+
+        Geometry (dim/heads/head_dim/max_len/vocab/depth/ln_eps) must match
+        the engine's current spec: the decode step is param-*shape*-stable,
+        so the swap reuses every compiled program — no retrace, no
+        recompile.  The loop applies the swap at the first iteration with
+        zero active slots (admission pauses until then): in-flight requests
+        finish under the old params, queued requests decode under the new,
+        and nothing drops.  With a draft model, only the target swaps — the
+        verify step guarantees target-distribution samples under any draft,
+        so acceptance rate may dip but correctness cannot."""
+        new = _resolve_spec(model, params)
+        old = self._spec
+        for f in ("dim", "heads", "head_dim", "max_len", "vocab", "ln_eps"):
+            if getattr(new, f) != getattr(old, f):
+                raise ValueError(
+                    f"hot_swap geometry mismatch on {f}: "
+                    f"{getattr(new, f)} != {getattr(old, f)}"
+                )
+        if len(new.blocks) != len(old.blocks):
+            raise ValueError(
+                f"hot_swap depth mismatch: {len(new.blocks)} blocks "
+                f"!= {len(old.blocks)}"
+            )
+        with self._cv:
+            if self._crashed:
+                raise EngineCrashed("engine crashed; cannot hot_swap")
+            if self._swap is not None:
+                raise RuntimeError("another hot_swap is already in flight")
+            if not self._running:
+                # no loop ⇒ no in-flight work: swap synchronously
+                self._spec = new
+                self._metrics["hot_swaps"].inc()
+                return
+            done = threading.Event()
+            self._swap = (new, done)
+            self._cv.notify_all()
+        if not done.wait(timeout):
+            with self._cv:
+                self._swap = None
+            raise TimeoutError(f"hot_swap did not drain within {timeout}s")
 
     @property
     def prefill_buckets(self) -> Tuple[int, ...]:
@@ -835,12 +965,78 @@ class ServingEngine:
             with self._cv:
                 if not self._running:
                     return
-            progressed = self._admit()
-            progressed = self._decode_once() or progressed
+                self._drain_ack = self._draining
+                paused = self._draining or self._swap is not None
+            try:
+                self._cancel_requested()
+                if self._swap is not None and not self._active.any():
+                    self._apply_swap()
+                    with self._cv:
+                        paused = self._draining
+                progressed = False if paused else self._admit()
+                if _chaos.enabled() and self._active.any():
+                    # the kill_replica site: only busy iterations count, so
+                    # a seeded kill always lands mid-decode with requests in
+                    # flight (the failover path is what's under test)
+                    _chaos.fault("replica")
+                progressed = self._decode_once() or progressed
+            except _chaos.ChaosKilled:
+                self._crash()
+                return
             if not progressed:
                 with self._cv:
-                    if self._running and len(self._queue) == 0:
+                    if (self._running and self._swap is None
+                            and not self._cancelled
+                            and (paused or len(self._queue) == 0)):
                         self._cv.wait(timeout=0.05)
+
+    def _cancel_requested(self) -> None:
+        """Retire every slot whose request was cancelled (loop thread only)."""
+        with self._cv:
+            if not self._cancelled:
+                return
+            cancelled, self._cancelled = self._cancelled, []
+        for pending in cancelled:
+            if pending.done():
+                continue
+            if self._queue.remove(pending):
+                self._finish(pending, [], "aborted", 0.0)
+                continue
+            for slot, state in enumerate(self._slots):
+                if state is not None and state.pending is pending:
+                    self._retire(slot, "aborted")
+                    break
+        self._metrics["queue_depth"].set(len(self._queue))
+
+    def _apply_swap(self) -> None:
+        """Apply a pending hot-swap (loop thread, zero active slots)."""
+        spec, done = self._swap
+        self._spec = spec
+        with self._cv:
+            self._swap = None
+        self._metrics["hot_swaps"].inc()
+        done.set()
+
+    def _crash(self) -> None:
+        # Runs ON the loop thread after a chaos kill — the in-process
+        # analogue of the replica's process dying mid-decode.  Every
+        # in-flight and queued request aborts (partial tokens included) and
+        # the engine refuses further work; the tier's probe sees alive=False
+        # and its router fails the aborted requests over.
+        with self._cv:
+            self._crashed = True
+            self._running = False
+            self._thread = None
+            self._cv.notify_all()
+        for slot in range(self.num_slots):
+            if self._slots[slot] is not None:
+                self._retire(slot, "aborted")
+        while True:
+            pending = self._queue.pop()
+            if pending is None:
+                break
+            self._finish(pending, [], "aborted", 0.0)
+        self._metrics["queue_depth"].set(0)
 
     def _admit(self) -> bool:
         """Move queued requests into free slots (prefill).  FIFO with

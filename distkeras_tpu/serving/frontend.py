@@ -47,6 +47,10 @@ class GenerateRequest:
     when that token is emitted.  ``speculative`` opts a single request in
     (True) or out (False) of the engine's draft-model fast path; None
     (default) follows the engine — speculative whenever it has a draft.
+    ``timeout_s`` is the caller's *remaining* deadline budget: the serving
+    tier decrements it per hop so a replica's HTTP handler times out (and
+    self-cancels) no later than the router's own 504 — one deadline,
+    propagated, instead of stacked independent timeouts.
     """
 
     prompt: List[int]
@@ -58,6 +62,7 @@ class GenerateRequest:
     eos_id: Optional[int] = None
     request_id: str = ""
     speculative: Optional[bool] = None
+    timeout_s: Optional[float] = None
 
     def validate(self) -> None:
         if not self.prompt:
@@ -66,8 +71,12 @@ class GenerateRequest:
             raise ValueError("prompt token ids must be >= 0")
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if not (0.0 <= self.top_p <= 1.0) and self.top_p != 1.0:
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not (0.0 <= self.top_p <= 1.0):
             raise ValueError(f"top_p must be in [0, 1], got {self.top_p}")
+        if self.timeout_s is not None and self.timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {self.timeout_s}")
 
 
 @dataclasses.dataclass
@@ -119,6 +128,17 @@ class RequestQueue:
             if not self._items:
                 return None
             return self._items.popleft()
+
+    def remove(self, item) -> bool:
+        """Remove a queued item (identity match) before the engine admits
+        it; ``False`` if it is no longer queued.  The cancellation fast
+        path: a request that never reached a slot frees nothing."""
+        with self._lock:
+            for i, queued in enumerate(self._items):
+                if queued is item:
+                    del self._items[i]
+                    return True
+            return False
 
     def requeue_front(self, item) -> None:
         """Put a popped item back at the head — the engine's head-of-line
@@ -182,6 +202,8 @@ def _parse_request(request: dict) -> GenerateRequest:
                 else int(payload["eos_id"])),
         request_id=str(payload.get("request_id", "")),
         speculative=_parse_tristate(payload.get("speculative")),
+        timeout_s=(None if payload.get("timeout_s") in (None, "", "None")
+                   else float(payload["timeout_s"])),
     )
     req.validate()
     return req
@@ -192,7 +214,13 @@ def install_http_endpoint(engine, path: str = "/generate",
     """Mount a ``/generate`` endpoint for ``engine`` on the flightdeck
     exporter.  Blocking request/response: the handler thread (flightdeck's
     ``ThreadingHTTPServer`` runs one per connection) submits and waits for
-    the result.  Returns the mounted path."""
+    the result.  A request carrying ``timeout_s`` (the router's propagated
+    deadline budget) bounds its own wait to that remainder.  On timeout the
+    pending request is cancelled so the engine reclaims its slot/pages —
+    the 504 is a *release*, not a leak — which is also what makes router
+    failover idempotent over HTTP: by the time the retry lands elsewhere,
+    this replica is provably no longer executing the request.  Returns the
+    mounted path."""
     from distkeras_tpu.telemetry.flightdeck import server as _server
 
     def handle(request):
@@ -204,11 +232,22 @@ def install_http_endpoint(engine, path: str = "/generate",
         try:
             pending = engine.submit(req)
         except QueueFull as e:
-            return ("application/json", json.dumps({"error": str(e)}), 503)
-        result = pending.result(timeout=timeout)
+            return ("application/json", json.dumps({"error": str(e)}), 503,
+                    {"Retry-After": "1"})
+        budget = timeout
+        if req.timeout_s is not None:
+            budget = req.timeout_s if budget is None else min(budget,
+                                                              req.timeout_s)
+        result = pending.result(timeout=budget)
         if result is None:
+            engine.cancel(pending)
             body = json.dumps({"error": "generation timed out"})
             return ("application/json", body, 504)
+        if result.finish_reason == "aborted":
+            # engine stopped/crashed with the request in flight — a retryable
+            # server condition, not a successful generation
+            return ("application/json", result.to_json(), 503,
+                    {"Retry-After": "1"})
         return ("application/json", result.to_json(), 200)
 
     _server.add_endpoint(path, handle)

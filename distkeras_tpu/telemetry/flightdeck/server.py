@@ -35,7 +35,7 @@ import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional
 
 from distkeras_tpu.telemetry import runtime as _runtime
 from distkeras_tpu.telemetry.flightdeck import correlate
@@ -146,11 +146,13 @@ def add_endpoint(path: str, fn: Callable) -> None:
 
     * ``fn() -> (content_type, body)`` — read-only GET view (the daemon's
       fleet ``/aggregate``);
-    * ``fn(request) -> (content_type, body[, status])`` — request-aware:
-      ``request`` is ``{"method": "GET"|"POST", "query": <raw query
-      string>, "body": <decoded POST body or "">}``, and the optional
-      third element sets the HTTP status (the serving ``/generate``
-      endpoint's 400/503/504).  Request-aware endpoints also receive POSTs.
+    * ``fn(request) -> (content_type, body[, status[, headers]])`` —
+      request-aware: ``request`` is ``{"method": "GET"|"POST", "query":
+      <raw query string>, "body": <decoded POST body or "">}``, the
+      optional third element sets the HTTP status (the serving
+      ``/generate`` endpoint's 400/503/504), and the optional fourth is a
+      dict of extra response headers (e.g. ``Retry-After`` on a 503).
+      Request-aware endpoints also receive POSTs.
     """
     _EXTRA[path] = fn
 
@@ -189,8 +191,9 @@ def _write_discovery_file() -> None:
 # ------------------------------------------------------------------ handler
 
 
-def _render(path: str, request: Optional[dict] = None) -> Optional[Tuple[str, str, int]]:
-    """``(content_type, body, status)`` for one endpoint, ``None`` for 404."""
+def _render(path: str, request: Optional[dict] = None):
+    """``(content_type, body, status[, headers])`` for one endpoint,
+    ``None`` for 404."""
     # Lazy: metrics/trace/dynamics import this package for their ring feeds.
     from distkeras_tpu import sanitizer as _sanitizer
     from distkeras_tpu.telemetry import dynamics as _dynamics
@@ -266,8 +269,9 @@ class _Handler(BaseHTTPRequestHandler):
             known = ["/metrics", "/healthz", "/vars", "/trace", *sorted(_EXTRA)]
             self._reply(404, "text/plain", "not found; endpoints: " + " ".join(known))
             return
-        ctype, text, status = payload
-        self._reply(status, ctype, text)
+        ctype, text, status = payload[:3]
+        headers = payload[3] if len(payload) > 3 else None
+        self._reply(status, ctype, text, headers)
 
     def do_GET(self):  # noqa: N802 — http.server API
         self._dispatch("GET")
@@ -275,10 +279,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802 — http.server API
         self._dispatch("POST")
 
-    def _reply(self, code: int, ctype: str, body: str) -> None:
+    def _reply(self, code: int, ctype: str, body: str,
+               headers: Optional[Dict[str, str]] = None) -> None:
         data = body.encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, str(value))
         self.end_headers()
         self.wfile.write(data)

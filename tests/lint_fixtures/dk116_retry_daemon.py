@@ -1,0 +1,59 @@
+"""DK116 fixture — retry loops in a daemon module (basename keeps it in
+scope).  Lines are pinned by tests/test_lint.py."""
+
+import socket
+import time
+
+from distkeras_tpu.networking import recv_data, send_data
+
+
+def bad_hot_reconnect(host, port):
+    while True:  # DK116: swallows + no pacing = hot spin / stampede
+        try:
+            sock = socket.create_connection((host, port), timeout=5.0)
+            return sock
+        except OSError:
+            pass
+
+
+def bad_swallowed_rpc(sock, msg):
+    while True:  # DK116: network helper retried forever, unpaced
+        try:
+            send_data(sock, msg)
+            return recv_data(sock)
+        except ConnectionError:
+            continue
+
+
+def good_paced_reconnect(host, port):
+    while True:  # paced: the sleep bounds the retry rate
+        try:
+            return socket.create_connection((host, port), timeout=5.0)
+        except OSError:
+            time.sleep(0.5)
+
+
+def good_counted_retry(host, port):
+    for _ in range(3):  # counted loop: bounded by construction
+        try:
+            return socket.create_connection((host, port), timeout=5.0)
+        except OSError:
+            pass
+    raise ConnectionError("unreachable host")
+
+
+def good_handler_raises(sock, msg):
+    while True:  # failure propagates — not an unbounded retry
+        try:
+            send_data(sock, msg)
+            return recv_data(sock)
+        except ConnectionError:
+            raise
+
+
+def good_no_network(queue):
+    while True:  # spin without network calls is DK112's business, not ours
+        try:
+            return queue.pop(0)
+        except IndexError:
+            pass

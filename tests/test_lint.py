@@ -424,6 +424,32 @@ def test_dk115_socket_timeout_fixture():
     ]
 
 
+def test_dk116_retry_cap_fixture():
+    got, _ = _run("dk116_retry_daemon.py", ["DK116"])
+    assert got == [
+        ("DK116", 11),  # hot reconnect: swallowed OSError, no pacing
+        ("DK116", 20),  # networking helpers retried forever, unpaced
+    ]
+
+
+def test_dk116_out_of_scope_module_is_silent(tmp_path):
+    """The same unbounded retry outside the daemon/server/tier scope stays
+    unflagged — a one-shot script may poll however it likes."""
+    src = (
+        "import socket\n"
+        "def f(host):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return socket.create_connection((host, 1), timeout=1)\n"
+        "        except OSError:\n"
+        "            pass\n"
+    )
+    mod = tmp_path / "batch_tool.py"
+    mod.write_text(src)
+    findings, _ = analyze([str(mod)], root=str(tmp_path), select=["DK116"])
+    assert findings == []
+
+
 def test_dk115_out_of_scope_module_is_silent(tmp_path):
     """Same code outside the daemon/server scope stays unflagged — batch
     code may legitimately block forever."""
@@ -551,7 +577,7 @@ def test_all_rules_registered():
     assert sorted(all_rules()) == [
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
         "DK108", "DK109", "DK110", "DK111", "DK112", "DK113", "DK114",
-        "DK115",
+        "DK115", "DK116",
     ]
 
 

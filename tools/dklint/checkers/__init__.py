@@ -13,6 +13,7 @@ from tools.dklint.checkers import (  # noqa: F401 — registration side effects
     printlog,
     prng_lineage,
     recompile,
+    retry_cap,
     socket_timeout,
     traced_branch,
     wallclock,

@@ -1,0 +1,164 @@
+"""DK116 — unbounded, backoff-less retry loop around a network call.
+
+The control plane retries by policy: the ``Job`` client's ``_rpc`` runs a
+*counted* attempt loop with capped-exponential jittered backoff, and the
+serving tier's dispatch loop re-routes under a deadline with
+``_backoff()`` between hops.  The anti-pattern this rule pins is the
+other shape: ``while True:`` around a socket/HTTP call whose ``except``
+handler swallows the failure (no ``raise``/``break``/``return``) and
+whose body never sleeps or waits.  Against a dead peer that loop is a
+hot spin; against a *recovering* peer it is a reconnect stampede — a
+fleet of such clients synchronously hammering the daemon the moment it
+comes back, which is exactly the failure the jittered backoff in
+``_rpc`` exists to prevent.
+
+A loop stays silent when any of these bound it:
+
+* the loop is counted (``for ... in range(...)`` or a real ``while``
+  condition) — only literal ``while True`` / ``while 1`` can spin
+  unboundedly;
+* the failure handler exits (``raise``, ``break``, ``return``) — one
+  failed attempt propagates instead of retrying forever;
+* the body sleeps/waits anywhere (``time.sleep``, ``Event.wait``,
+  ``Condition.wait``, or any call whose name mentions ``backoff``) —
+  paced retries are a legitimate supervision loop.
+
+Network calls are recognized the same way DK115 recognizes sockets:
+blocking socket methods on a name receiver, plus calls resolved through
+the import table to ``socket.create_connection``,
+``urllib.request.urlopen``, or the :mod:`distkeras_tpu.networking`
+helpers (``connect`` / ``send_data`` / ``recv_data``).
+
+Scope: the DK115 daemon/server modules plus any module whose basename
+mentions ``tier`` — the serving router retries by design, so its loops
+must prove they are paced.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+
+from tools.dklint.checkers.socket_timeout import BLOCKING_METHODS, _resolved
+
+_SCOPE_BASENAMES = frozenset({"networking.py", "job_deployment.py", "fleet.py"})
+_SCOPE_MARKERS = ("server", "daemon", "frontend", "tier")
+
+# resolved (import-table) call names that hit the network
+_NETWORK_CALLS = frozenset({
+    "socket.create_connection",
+    "urllib.request.urlopen",
+    "distkeras_tpu.networking.connect",
+    "distkeras_tpu.networking.send_data",
+    "distkeras_tpu.networking.recv_data",
+})
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _in_scope(fi: FileInfo) -> bool:
+    base = os.path.basename(fi.relpath)
+    return base in _SCOPE_BASENAMES or any(m in base for m in _SCOPE_MARKERS)
+
+
+def _is_forever(loop: ast.While) -> bool:
+    test = loop.test
+    return isinstance(test, ast.Constant) and test.value in (True, 1)
+
+
+def _loop_nodes(loop: ast.While) -> List[ast.AST]:
+    """Nodes of the loop body, excluding nested function/loop scopes (a
+    nested loop or closure is its own retry decision, judged separately)."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(loop.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FN_NODES + (ast.While, ast.For)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _is_network_call(fi: FileInfo, node: ast.Call) -> bool:
+    if (
+        isinstance(node.func, ast.Attribute)
+        and isinstance(node.func.value, ast.Name)
+        and node.func.attr in BLOCKING_METHODS
+    ):
+        return True
+    name = _resolved(fi, node)
+    if name in _NETWORK_CALLS:
+        return True
+    # bare-name project helpers (`from ..networking import send_data`)
+    return name.rpartition(".")[2] in ("send_data", "recv_data") or (
+        name == "connect" and not isinstance(node.func, ast.Attribute))
+
+
+def _paces(node: ast.Call) -> bool:
+    """A call that bounds the loop's retry rate: sleep / wait / backoff."""
+    name = call_name(node) or ""
+    tail = name.rpartition(".")[2]
+    return tail in ("sleep", "wait") or "backoff" in tail.lower()
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when no path out of the handler leaves the loop: the handler
+    body contains no raise/break/return at any depth (nested scopes
+    excluded), so a failed attempt always falls through to the retry."""
+    stack: List[ast.AST] = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FN_NODES):
+            continue
+        if isinstance(node, (ast.Raise, ast.Break, ast.Return)):
+            return False
+        stack.extend(ast.iter_child_nodes(node))
+    return True
+
+
+@register
+class RetryCapChecker(Checker):
+    rule = "DK116"
+    name = "retry-without-cap"
+    description = (
+        "while-True retry around a network call that swallows failures "
+        "with no attempt cap and no sleep/backoff (hot spin + reconnect "
+        "stampede)"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        if not _in_scope(fi):
+            return
+        for loop in ast.walk(fi.tree):
+            if not isinstance(loop, ast.While) or not _is_forever(loop):
+                continue
+            body = _loop_nodes(loop)
+            swallowing = [n for n in body
+                          if isinstance(n, ast.ExceptHandler)
+                          and _handler_swallows(n)]
+            if not swallowing:
+                continue
+            calls = [n for n in body if isinstance(n, ast.Call)]
+            network = [c for c in calls if _is_network_call(fi, c)]
+            if not network:
+                continue
+            if any(_paces(c) for c in calls):
+                continue
+            site = min(network, key=lambda c: c.lineno)
+            yield Finding(
+                path=fi.relpath,
+                line=loop.lineno,
+                col=loop.col_offset,
+                rule=self.rule,
+                message=(
+                    "unbounded retry: while True around a network call "
+                    f"(line {site.lineno}) whose except handler swallows "
+                    "the failure, with no sleep/backoff in the loop — cap "
+                    "the attempts or pace the retries (see Job._rpc)"
+                ),
+            )

@@ -30,8 +30,8 @@ at the call site itself (one finding per root cause: sockets derived from
 an already-flagged call are not re-flagged downstream).
 
 Scope: ``networking.py`` / ``job_deployment.py`` / ``fleet.py`` plus any
-module whose basename mentions server/daemon/frontend.  Batch/offline
-code may legitimately block forever; serving threads may not.
+module whose basename mentions server/daemon/frontend/tier.  Batch/
+offline code may legitimately block forever; serving threads may not.
 """
 
 from __future__ import annotations
@@ -48,7 +48,7 @@ from tools.dklint.registry import register
 BLOCKING_METHODS = frozenset({"recv", "recv_into", "recvfrom", "accept", "connect"})
 
 _SCOPE_BASENAMES = frozenset({"networking.py", "job_deployment.py", "fleet.py"})
-_SCOPE_MARKERS = ("server", "daemon", "frontend")
+_SCOPE_MARKERS = ("server", "daemon", "frontend", "tier")
 
 _FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
