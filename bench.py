@@ -521,12 +521,41 @@ def ensure_backend(pending):
     return backend
 
 
+def _profile_pointer(result: dict) -> dict:
+    """Machine-readable pointer from a result row to its profile evidence:
+    the ``DISTKERAS_PROFILE`` trace dir (None when no window was
+    requested), whether a capture actually landed there, and the row's
+    phase breakdown — enough for ``tools.dkprof report`` to attribute the
+    run (CPU-fallback smoke included) without re-running it."""
+    root = os.environ.get("DISTKERAS_PROFILE")
+    trace_dir = os.path.abspath(root) if root else None
+    return {
+        "trace_dir": trace_dir,
+        "captured": _profile_captured(trace_dir),
+        "phases": result.get("phases", {}),
+    }
+
+
+def _profile_captured(trace_dir) -> bool:
+    """True when ``trace_dir`` holds at least one closed capture (the
+    ``plugins/profile/<ts>/*.xplane.pb`` layout jax.profiler writes)."""
+    if not trace_dir:
+        return False
+    import glob
+
+    for pattern in ("*.xplane.pb", "*.trace.json.gz"):
+        if glob.glob(os.path.join(trace_dir, "**", pattern), recursive=True):
+            return True
+    return False
+
+
 def _ok_line(result: dict) -> str:
     """Serialize a result with an at-a-glance verdict.  The deadman design
     (rc 0 + error lines) means the process exit code never carries the
     verdict — a reader skimming only `value` could mistake an error row
     for a measurement (round-4 review).  Every line now says which it is."""
     result.setdefault("status", "error" if result.get("error") else "ok")
+    result.setdefault("profile", _profile_pointer(result))
     return json.dumps(result)
 
 
@@ -893,6 +922,20 @@ def _run_config_instrumented(config, n_windows, reps, k, num_workers,
                 f"implied MFU {implied_mfu:.1f} exceeds the hardware roofline "
                 "— device returned without executing (tunnel/device fault?)"
             )
+    # Profile evidence for the row's `profile` pointer: one extra untimed
+    # dispatch of the SAME executable under jax.profiler, after the timed
+    # region so the capture perturbs nothing it reports on.  Per-config
+    # subdir, so a sweep's captures don't clobber each other.
+    profile_root = os.environ.get("DISTKERAS_PROFILE")
+    if profile_root:
+        pdir = os.path.join(profile_root, config)
+        os.makedirs(pdir, exist_ok=True)
+        jax.profiler.start_trace(pdir)
+        try:
+            state, _ = engine.run_epochs(state, xs, ys, reps)
+            jax.block_until_ready(state.center_params)
+        finally:
+            jax.profiler.stop_trace()
     # Cross-check compile only after the timed region (see _xla_step_flops).
     xla_step = _xla_step_flops(engine, state, xs, ys) if peak else None
     gc.collect()
@@ -1732,12 +1775,22 @@ def main():
             deadman.disarm()
 
     if args.write_baseline and jax.process_index() == 0:
+        profile_root = os.environ.get("DISTKERAS_PROFILE")
         if _PLATFORM_FALLBACK or cpu_smoke:
             _emit_error("--write-baseline refused: this run measured a CPU "
                         "fallback, not the real backend",
                         metric="write_baseline")
         elif missing := [c for c in configs if c not in pinned_results]:
             _emit_error(f"--write-baseline refused: no result for {missing}",
+                        metric="write_baseline")
+        elif not _profile_captured(
+                os.path.abspath(profile_root) if profile_root else None):
+            # a pin without a trace is a verdict string nobody can audit:
+            # dkprof needs the xplane capture to attribute any later
+            # regression against this baseline
+            _emit_error("--write-baseline refused: no profile trace "
+                        "captured — run with DISTKERAS_PROFILE=<dir> so "
+                        "the pin carries dkprof-attributable evidence",
                         metric="write_baseline")
         else:
             write_baseline(pinned_results)

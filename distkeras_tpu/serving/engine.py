@@ -78,6 +78,8 @@ import numpy as np
 
 from distkeras_tpu import chaos as _chaos
 from distkeras_tpu.sanitizer import lockwatch
+from distkeras_tpu.telemetry import runtime as _truntime
+from distkeras_tpu.telemetry.trace import NOOP_SPAN, trace as _trace
 from distkeras_tpu.serving.cache import PagedKVCache, append_rows, rollback_rows
 from distkeras_tpu.serving.frontend import (
     GenerateRequest,
@@ -792,7 +794,19 @@ class ServingEngine:
     def submit(self, request: GenerateRequest) -> _Pending:
         """Validate + enqueue; returns a :class:`_Pending` handle.  Raises
         :class:`~distkeras_tpu.serving.frontend.QueueFull` under
-        backpressure and ``ValueError`` for an unservable request."""
+        backpressure and ``ValueError`` for an unservable request.  The
+        admission is a ``serving.admit`` span — on the caller's thread, so
+        it nests under whatever hop span (``tier.attempt``,
+        ``serving.http_request``) drove the submit."""
+        span = NOOP_SPAN
+        if _truntime.enabled():
+            span = _trace.span(
+                "serving.admit", request_id=request.request_id,
+                trace_id=request.trace_id)
+        with span:
+            return self._submit(request)
+
+    def _submit(self, request: GenerateRequest) -> _Pending:
         if self._crashed:
             raise EngineCrashed("serving engine crashed; replica is dead")
         request.validate()
@@ -888,21 +902,27 @@ class ServingEngine:
         """Pause admission and wait until every occupied slot retires.
         Queued requests stay queued (they admit again after
         :meth:`resume`).  Returns ``True`` once drained; ``False`` on
-        timeout (admission stays paused either way)."""
-        with self._cv:
-            self._draining = True
-            started = self._thread is not None
-            self._cv.notify_all()
-        if not started:
-            return True  # no loop ⇒ nothing in flight, nothing can admit
-        deadline = time.perf_counter() + timeout
-        while time.perf_counter() < deadline:
-            if not self._running:
-                return True  # stopped/crashed under us — slots are clear
-            if self._drain_ack and not self._active.any():
-                return True
-            time.sleep(0.002)
-        return False
+        timeout (admission stays paused either way).  The wait is a
+        ``serving.drain`` span, so a request that stalls behind a drain
+        shows the interference on its critical path."""
+        span = NOOP_SPAN
+        if _truntime.enabled():
+            span = _trace.span("serving.drain")
+        with span:
+            with self._cv:
+                self._draining = True
+                started = self._thread is not None
+                self._cv.notify_all()
+            if not started:
+                return True  # no loop ⇒ nothing in flight, nothing can admit
+            deadline = time.perf_counter() + timeout
+            while time.perf_counter() < deadline:
+                if not self._running:
+                    return True  # stopped/crashed under us — slots are clear
+                if self._drain_ack and not self._active.any():
+                    return True
+                time.sleep(0.002)
+            return False
 
     def resume(self) -> None:
         """Reopen admission after :meth:`drain`."""
@@ -922,7 +942,17 @@ class ServingEngine:
         finish under the old params, queued requests decode under the new,
         and nothing drops.  With a draft model, only the target swaps — the
         verify step guarantees target-distribution samples under any draft,
-        so acceptance rate may dip but correctness cannot."""
+        so acceptance rate may dip but correctness cannot.  The blocking
+        window (geometry check through drain-and-apply) is a
+        ``serving.hot_swap`` span — the other interference source a
+        request's critical path can surface."""
+        span = NOOP_SPAN
+        if _truntime.enabled():
+            span = _trace.span("serving.hot_swap")
+        with span:
+            self._hot_swap(model, params, timeout)
+
+    def _hot_swap(self, model, params, timeout: float) -> None:
         new = _resolve_spec(model, params)
         old = self._spec
         for f in ("dim", "heads", "head_dim", "max_len", "vocab", "ln_eps"):
@@ -1070,29 +1100,44 @@ class ServingEngine:
         # max_context and submit bounded plen, so next() can't exhaust)
         width = next(w for w in self._buckets if w >= plen)
         t0 = time.perf_counter()
-        tokens = np.zeros((1, width), np.int32)
-        tokens[0, :plen] = req.prompt
-        tokens_dev = jnp.asarray(tokens)
-        table = jnp.asarray(
-            self._cache.tables[slot, : width // self._cache.page_size])
-        kp, vp, tok, key = self._prefill_for(width)(
-            self._spec.params(), self._cache.k_pages, self._cache.v_pages,
-            tokens_dev, table, jnp.int32(plen), jax.random.PRNGKey(req.seed),
-            jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jnp.float32(req.top_p),
-        )
-        self._cache.k_pages, self._cache.v_pages = kp, vp
-        spec_on = self._draft_spec is not None and req.speculative is not False
-        if spec_on:
-            dc = self._draft_cache
-            dkp, dvp = self._prefill_for(width, role="draft")(
-                self._draft_spec.params(), dc.k_pages, dc.v_pages,
-                tokens_dev, table)
-            dc.k_pages, dc.v_pages = dkp, dvp
-            # a draft chain decorrelated from the request's target chain
-            self._draft_keys[slot] = np.asarray(
-                jax.random.fold_in(jax.random.PRNGKey(req.seed), 7))
-        tok0 = int(np.asarray(tok))
+        span = NOOP_SPAN
+        if _truntime.enabled():
+            # the loop thread serves every request, so the ids ride span
+            # args (no thread-bound context here); queue wait spans the gap
+            # between the admission thread's enqueue and this prefill
+            _trace.record(
+                "serving.queue_wait", pending.enqueue_t, t0,
+                request_id=req.request_id, trace_id=req.trace_id,
+                parent="serving.admit")
+            span = _trace.span(
+                "serving.prefill", request_id=req.request_id,
+                trace_id=req.trace_id, parent="serving.admit", slot=slot,
+                width=width, plen=plen)
+        with span:
+            tokens = np.zeros((1, width), np.int32)
+            tokens[0, :plen] = req.prompt
+            tokens_dev = jnp.asarray(tokens)
+            table = jnp.asarray(
+                self._cache.tables[slot, : width // self._cache.page_size])
+            kp, vp, tok, key = self._prefill_for(width)(
+                self._spec.params(), self._cache.k_pages,
+                self._cache.v_pages, tokens_dev, table, jnp.int32(plen),
+                jax.random.PRNGKey(req.seed), jnp.float32(req.temperature),
+                jnp.int32(req.top_k), jnp.float32(req.top_p),
+            )
+            self._cache.k_pages, self._cache.v_pages = kp, vp
+            spec_on = (self._draft_spec is not None
+                       and req.speculative is not False)
+            if spec_on:
+                dc = self._draft_cache
+                dkp, dvp = self._prefill_for(width, role="draft")(
+                    self._draft_spec.params(), dc.k_pages, dc.v_pages,
+                    tokens_dev, table)
+                dc.k_pages, dc.v_pages = dkp, dvp
+                # a draft chain decorrelated from the request's target chain
+                self._draft_keys[slot] = np.asarray(
+                    jax.random.fold_in(jax.random.PRNGKey(req.seed), 7))
+            tok0 = int(np.asarray(tok))
         now = time.perf_counter()
         self._metrics["prefill_seconds"].observe(now - t0)
         self._metrics["prefill_padded"].inc(width - plen)
@@ -1129,17 +1174,44 @@ class ServingEngine:
             self._plain_once()
         return True
 
+    def _step_span(self):
+        """A ``serving.decode_step`` span for one engine iteration.  One
+        jitted step serves every active slot, so attribution is a *list* of
+        request ids (``args.requests``); when a single request — or a
+        single trace — is active, the scalar ``request_id``/``trace_id``
+        are promoted too so per-request tooling joins without list
+        handling.  NOOP when telemetry is off (no list building either)."""
+        if not _truntime.enabled():
+            return NOOP_SPAN
+        reqs = [self._slots[i].pending.request
+                for i in range(self.num_slots)
+                if self._active[i] and self._slots[i] is not None]
+        attrs: Dict[str, Any] = {
+            "requests": [r.request_id for r in reqs],
+            "n_active": len(reqs),
+        }
+        traces = sorted({r.trace_id for r in reqs if r.trace_id})
+        if len(reqs) == 1:
+            attrs["request_id"] = reqs[0].request_id
+            attrs["parent"] = "serving.prefill"
+        if len(traces) == 1:
+            attrs["trace_id"] = traces[0]
+        elif traces:
+            attrs["trace_ids"] = traces
+        return _trace.span("serving.decode_step", **attrs)
+
     def _plain_once(self) -> None:
         t0 = time.perf_counter()
-        kp, vp, tok, keys = self._decode(
-            self._spec.params(), self._cache.k_pages, self._cache.v_pages,
-            jnp.asarray(self._cache.tables), jnp.asarray(self._pos),
-            jnp.asarray(self._last), jnp.asarray(self._keys),
-            jnp.asarray(self._temp), jnp.asarray(self._topk),
-            jnp.asarray(self._topp), jnp.asarray(self._active),
-        )
-        self._cache.k_pages, self._cache.v_pages = kp, vp
-        toks = np.asarray(tok)          # device sync: the step is done here
+        with self._step_span():
+            kp, vp, tok, keys = self._decode(
+                self._spec.params(), self._cache.k_pages, self._cache.v_pages,
+                jnp.asarray(self._cache.tables), jnp.asarray(self._pos),
+                jnp.asarray(self._last), jnp.asarray(self._keys),
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), jnp.asarray(self._active),
+            )
+            self._cache.k_pages, self._cache.v_pages = kp, vp
+            toks = np.asarray(tok)      # device sync: the step is done here
         self._keys = np.array(keys)     # np.array: keep the host copy writable
         self._metrics["token_latency"].observe(time.perf_counter() - t0)
         self._metrics["decode_steps"].inc()
@@ -1165,34 +1237,35 @@ class ServingEngine:
         window in one target step, then emit each slot's accepted prefix."""
         t0 = time.perf_counter()
         m = self._spec_tokens
-        tables = jnp.asarray(self._cache.tables)
-        temp = jnp.asarray(self._temp)
-        topk = jnp.asarray(self._topk)
-        topp = jnp.asarray(self._topp)
-        active = jnp.asarray(self._active)
-        base_pos = self._pos
-        last = jnp.asarray(self._last)
-        dkeys = jnp.asarray(self._draft_keys)
-        dc = self._draft_cache
-        dparams = self._draft_spec.params()
-        drafts, qprobs = [], []
-        for i in range(m):
-            dc.k_pages, dc.v_pages, tok, qp, dkeys = self._draft_step(
-                dparams, dc.k_pages, dc.v_pages, tables,
-                jnp.asarray(base_pos + i), last, dkeys, temp, topk, topp,
-                active)
-            drafts.append(tok)
-            qprobs.append(qp)
-            last = tok
-        kp, vp, out, count, accepted, keys = self._verify(
-            self._spec.params(), self._cache.k_pages, self._cache.v_pages,
-            tables, jnp.asarray(base_pos), jnp.asarray(self._last),
-            tuple(drafts), tuple(qprobs), jnp.asarray(self._keys),
-            temp, topk, topp, active, jnp.asarray(self._spec_on))
-        self._cache.k_pages, self._cache.v_pages = kp, vp
-        out = np.asarray(out)           # device sync: the iteration is done
-        counts = np.asarray(count)
-        acc = np.asarray(accepted)
+        with self._step_span():
+            tables = jnp.asarray(self._cache.tables)
+            temp = jnp.asarray(self._temp)
+            topk = jnp.asarray(self._topk)
+            topp = jnp.asarray(self._topp)
+            active = jnp.asarray(self._active)
+            base_pos = self._pos
+            last = jnp.asarray(self._last)
+            dkeys = jnp.asarray(self._draft_keys)
+            dc = self._draft_cache
+            dparams = self._draft_spec.params()
+            drafts, qprobs = [], []
+            for i in range(m):
+                dc.k_pages, dc.v_pages, tok, qp, dkeys = self._draft_step(
+                    dparams, dc.k_pages, dc.v_pages, tables,
+                    jnp.asarray(base_pos + i), last, dkeys, temp, topk, topp,
+                    active)
+                drafts.append(tok)
+                qprobs.append(qp)
+                last = tok
+            kp, vp, out, count, accepted, keys = self._verify(
+                self._spec.params(), self._cache.k_pages, self._cache.v_pages,
+                tables, jnp.asarray(base_pos), jnp.asarray(self._last),
+                tuple(drafts), tuple(qprobs), jnp.asarray(self._keys),
+                temp, topk, topp, active, jnp.asarray(self._spec_on))
+            self._cache.k_pages, self._cache.v_pages = kp, vp
+            out = np.asarray(out)       # device sync: the iteration is done
+            counts = np.asarray(count)
+            acc = np.asarray(accepted)
         self._keys = np.array(keys)
         self._draft_keys = np.array(dkeys)
         self._metrics["token_latency"].observe(time.perf_counter() - t0)
@@ -1251,6 +1324,7 @@ class ServingEngine:
             finish_reason=reason,
             ttft_s=ttft_s,
             latency_s=time.perf_counter() - pending.enqueue_t,
+            trace_id=pending.request.trace_id,
         ))
 
     def _refresh_gauges(self) -> None:

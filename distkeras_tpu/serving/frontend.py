@@ -50,7 +50,12 @@ class GenerateRequest:
     ``timeout_s`` is the caller's *remaining* deadline budget: the serving
     tier decrements it per hop so a replica's HTTP handler times out (and
     self-cancels) no later than the router's own 504 — one deadline,
-    propagated, instead of stacked independent timeouts.
+    propagated, instead of stacked independent timeouts.  ``trace_id``
+    correlates every span the request produces across router, replica, and
+    engine (minted at the first hop that sees the request, carried over the
+    HTTP hop in the body and as ``X-DK-Trace-Id``); ``request_id`` stays
+    the idempotency key.  Both ride trace-span args, never metric labels
+    (dklint DK117).
     """
 
     prompt: List[int]
@@ -63,6 +68,7 @@ class GenerateRequest:
     request_id: str = ""
     speculative: Optional[bool] = None
     timeout_s: Optional[float] = None
+    trace_id: str = ""
 
     def validate(self) -> None:
         if not self.prompt:
@@ -91,6 +97,7 @@ class GenerateResult:
     finish_reason: str
     ttft_s: float = 0.0
     latency_s: float = 0.0
+    trace_id: str = ""
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -180,8 +187,11 @@ def serve_flags() -> dict:
 
 def _parse_request(request: dict) -> GenerateRequest:
     """Build a :class:`GenerateRequest` from the flightdeck request dict
-    (``method``/``query``/``body``).  GET: ``prompt=1,2,3&max_new_tokens=8``;
-    POST: the same fields as a JSON object with ``prompt`` a list."""
+    (``method``/``query``/``body``/``headers``).  GET:
+    ``prompt=1,2,3&max_new_tokens=8``; POST: the same fields as a JSON
+    object with ``prompt`` a list.  ``request_id``/``trace_id`` fall back
+    to the ``X-DK-Request-Id``/``X-DK-Trace-Id`` headers the router's HTTP
+    hop sets, so trace context survives even a body that omits them."""
     if request.get("method") == "POST":
         payload = json.loads(request.get("body") or "{}")
     else:
@@ -204,7 +214,13 @@ def _parse_request(request: dict) -> GenerateRequest:
         speculative=_parse_tristate(payload.get("speculative")),
         timeout_s=(None if payload.get("timeout_s") in (None, "", "None")
                    else float(payload["timeout_s"])),
+        trace_id=str(payload.get("trace_id", "")),
     )
+    headers = request.get("headers") or {}
+    if not req.request_id:
+        req.request_id = str(headers.get("x-dk-request-id", ""))
+    if not req.trace_id:
+        req.trace_id = str(headers.get("x-dk-trace-id", ""))
     req.validate()
     return req
 
@@ -220,8 +236,18 @@ def install_http_endpoint(engine, path: str = "/generate",
     the 504 is a *release*, not a leak — which is also what makes router
     failover idempotent over HTTP: by the time the retry lands elsewhere,
     this replica is provably no longer executing the request.  Returns the
-    mounted path."""
+    mounted path.
+
+    The handler is also the frontend's trace-context mint: a request that
+    arrives without ``trace_id``/``request_id`` (a direct client, not a
+    router hop) gets fresh ids here, and the whole handler runs inside a
+    ``serving.http_request`` span bound to them — when the router sent the
+    request, ``X-DK-Parent-Span`` names the router-side span this one
+    logically nests under, stitching the cross-process trace."""
+    import uuid as _uuid
+
     from distkeras_tpu.telemetry.flightdeck import server as _server
+    from distkeras_tpu.telemetry.trace import new_trace_id, trace as _trace
 
     def handle(request):
         try:
@@ -229,26 +255,36 @@ def install_http_endpoint(engine, path: str = "/generate",
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             body = json.dumps({"error": f"{type(e).__name__}: {e}"})
             return ("application/json", body, 400)
-        try:
-            pending = engine.submit(req)
-        except QueueFull as e:
-            return ("application/json", json.dumps({"error": str(e)}), 503,
-                    {"Retry-After": "1"})
-        budget = timeout
-        if req.timeout_s is not None:
-            budget = req.timeout_s if budget is None else min(budget,
-                                                              req.timeout_s)
-        result = pending.result(timeout=budget)
-        if result is None:
-            engine.cancel(pending)
-            body = json.dumps({"error": "generation timed out"})
-            return ("application/json", body, 504)
-        if result.finish_reason == "aborted":
-            # engine stopped/crashed with the request in flight — a retryable
-            # server condition, not a successful generation
-            return ("application/json", result.to_json(), 503,
-                    {"Retry-After": "1"})
-        return ("application/json", result.to_json(), 200)
+        if not req.request_id:
+            req.request_id = _uuid.uuid4().hex
+        if not req.trace_id:
+            req.trace_id = new_trace_id()
+        span_attrs = {"request_id": req.request_id, "trace_id": req.trace_id}
+        parent = (request.get("headers") or {}).get("x-dk-parent-span")
+        if parent:
+            span_attrs["parent"] = str(parent)
+        with _trace.bind(trace_id=req.trace_id, request_id=req.request_id), \
+                _trace.span("serving.http_request", **span_attrs):
+            try:
+                pending = engine.submit(req)
+            except QueueFull as e:
+                return ("application/json", json.dumps({"error": str(e)}),
+                        503, {"Retry-After": "1"})
+            budget = timeout
+            if req.timeout_s is not None:
+                budget = req.timeout_s if budget is None else min(
+                    budget, req.timeout_s)
+            result = pending.result(timeout=budget)
+            if result is None:
+                engine.cancel(pending)
+                body = json.dumps({"error": "generation timed out"})
+                return ("application/json", body, 504)
+            if result.finish_reason == "aborted":
+                # engine stopped/crashed with the request in flight — a
+                # retryable server condition, not a successful generation
+                return ("application/json", result.to_json(), 503,
+                        {"Retry-After": "1"})
+            return ("application/json", result.to_json(), 200)
 
     _server.add_endpoint(path, handle)
     return path

@@ -75,6 +75,22 @@ from distkeras_tpu.serving.frontend import (
     GenerateResult,
     QueueFull,
 )
+from distkeras_tpu.telemetry import runtime as _truntime
+from distkeras_tpu.telemetry.trace import (
+    NOOP_SPAN,
+    new_trace_id,
+    trace as _trace,
+)
+
+
+def _span_note(span, **kv) -> None:
+    """Annotate a live span's args in place (no-op for the disabled-path
+    NOOP span) — how an attempt's *outcome* lands on a span that had to
+    open before the outcome was known."""
+    attrs = getattr(span, "attrs", None)
+    if attrs is not None:
+        attrs.update(kv)
+
 
 __all__ = [
     "HttpReplica",
@@ -226,6 +242,16 @@ class _HttpPending:
         # socket deadline trails the propagated budget so the replica's own
         # 504 (its self-cancel acknowledgement) arrives before we give up
         self._timeout = (timeout_s + 2.0) if timeout_s else 30.0
+        # trace context rides the hop as headers too, so even a replica
+        # frontend that drops unknown body fields keeps the correlation;
+        # X-DK-Parent-Span names the router-side span the replica's
+        # serving.http_request span nests under in the merged trace
+        self._headers = {"Content-Type": "application/json"}
+        if payload.get("request_id"):
+            self._headers["X-DK-Request-Id"] = payload["request_id"]
+        if payload.get("trace_id"):
+            self._headers["X-DK-Trace-Id"] = payload["trace_id"]
+            self._headers["X-DK-Parent-Span"] = "tier.attempt"
         self._event = threading.Event()
         self._result: Optional[GenerateResult] = None
         self._error: Optional[Exception] = None
@@ -234,10 +260,11 @@ class _HttpPending:
 
     def _run(self) -> None:
         try:
+            if _chaos.enabled():
+                _chaos.fault("http")  # stall_http: wedge the outbound hop
             data = json.dumps(self._payload).encode("utf-8")
             req = urllib.request.Request(
-                self._url, data=data,
-                headers={"Content-Type": "application/json"})
+                self._url, data=data, headers=self._headers)
             with urllib.request.urlopen(req, timeout=self._timeout) as resp:
                 body = resp.read().decode("utf-8", "replace")
             self._result = GenerateResult(**json.loads(body))
@@ -566,6 +593,27 @@ class ServingTier:
             # same id, so replica-side logs/metrics can correlate retries
             request = dataclasses.replace(
                 request, request_id=uuid.uuid4().hex)
+        if not request.trace_id:
+            # the correlation key: unlike request_id it is never used for
+            # idempotency decisions, only to join spans across processes
+            request = dataclasses.replace(request, trace_id=new_trace_id())
+        root = NOOP_SPAN
+        if _truntime.enabled():
+            root = _trace.span(
+                "tier.request", request_id=request.request_id,
+                trace_id=request.trace_id, budget_s=round(float(budget), 3))
+        with _trace.bind(trace_id=request.trace_id,
+                         request_id=request.request_id), root:
+            try:
+                result = self._dispatch(request, budget, deadline)
+            except TierError as e:
+                _span_note(root, outcome=type(e).__name__)
+                raise
+            _span_note(root, outcome="ok")
+            return result
+
+    def _dispatch(self, request: GenerateRequest, budget: float,
+                  deadline: float) -> GenerateResult:
         t0 = time.perf_counter()
         attempts = 0
         # replicas excluded for the rest of THIS request: saturated, or
@@ -595,65 +643,83 @@ class ServingTier:
             # (and self-cancels) exactly when the router stops waiting
             hop_request = dataclasses.replace(request, timeout_s=hop)
             attempts += 1
-            try:
-                handle = entry.replica.submit(hop_request)
-            except QueueFull:
-                exclude[entry.name] = "saturated"
-                attempts -= 1  # saturation is a shed decision, not a hop
-                continue
-            except (EngineCrashed, ReplicaDead, ConnectionError, OSError) as e:
-                self._mark_dead(entry, f"submit failed: {e}")
-                self._metrics["failovers"].inc()
-                self._backoff(attempts, deadline)
-                continue
-            with self._cv:
-                entry.inflight += 1
-            try:
+            # the attempt span stack-nests under tier.request (same
+            # thread); its outcome arg is what dktrace critical-path
+            # renders as the per-attempt verdict
+            aspan = NOOP_SPAN
+            if _truntime.enabled():
+                aspan = _trace.span(
+                    "tier.attempt", attempt=attempts, replica=entry.name,
+                    hop_s=round(float(hop), 3))
+            with aspan:
                 try:
-                    result = handle.result(timeout=hop)
-                except QueueFull:  # HTTP replicas surface 503 at result time
+                    handle = entry.replica.submit(hop_request)
+                except QueueFull:
+                    _span_note(aspan, outcome="saturated")
                     exclude[entry.name] = "saturated"
-                    attempts -= 1
+                    attempts -= 1  # saturation is a shed decision, not a hop
                     continue
-                except (ConnectionError, OSError) as e:
-                    self._probe_entry(entry)  # dead or flaky? decide now
-                    self._export_health()
+                except (EngineCrashed, ReplicaDead, ConnectionError,
+                        OSError) as e:
+                    _span_note(aspan, outcome="dead_on_submit")
+                    self._mark_dead(entry, f"submit failed: {e}")
                     self._metrics["failovers"].inc()
-                    entry.last_error = str(e)
                     self._backoff(attempts, deadline)
                     continue
-            finally:
                 with self._cv:
-                    entry.inflight -= 1
-            if result is None:
-                # slow hop: hedge — but only once the replica provably
-                # stopped executing (confirmed cancel / replica-side 504)
-                confirmed = entry.replica.cancel(handle)
-                if confirmed:
-                    late = handle.result(timeout=0)
-                    if late is not None and late.finish_reason != "aborted":
-                        result = late  # finished inside the cancel window
+                    entry.inflight += 1
+                try:
+                    try:
+                        result = handle.result(timeout=hop)
+                    except QueueFull:  # HTTP replicas surface 503 at result
+                        _span_note(aspan, outcome="saturated")
+                        exclude[entry.name] = "saturated"
+                        attempts -= 1
+                        continue
+                    except (ConnectionError, OSError) as e:
+                        _span_note(aspan, outcome="transport_error")
+                        self._probe_entry(entry)  # dead or flaky? decide now
+                        self._export_health()
+                        self._metrics["failovers"].inc()
+                        entry.last_error = str(e)
+                        self._backoff(attempts, deadline)
+                        continue
+                finally:
+                    with self._cv:
+                        entry.inflight -= 1
+                if result is None:
+                    # slow hop: hedge — but only once the replica provably
+                    # stopped executing (confirmed cancel / replica-side 504)
+                    confirmed = entry.replica.cancel(handle)
+                    if confirmed:
+                        late = handle.result(timeout=0)
+                        if late is not None and late.finish_reason != "aborted":
+                            result = late  # finished inside the cancel window
+                        else:
+                            _span_note(aspan, outcome="hedge")
+                            self._metrics["hedges"].inc()
+                            self._backoff(attempts, deadline)
+                            continue
                     else:
+                        _span_note(aspan, outcome="hedge_uncancelled")
+                        exclude[entry.name] = "uncancelled"
                         self._metrics["hedges"].inc()
                         self._backoff(attempts, deadline)
                         continue
-                else:
-                    exclude[entry.name] = "uncancelled"
-                    self._metrics["hedges"].inc()
+                if result.finish_reason == "aborted":
+                    # the replica stopped/crashed with the request in flight
+                    # — THE failover case; re-probe so routing reacts now
+                    _span_note(aspan, outcome="aborted_failover")
+                    self._probe_entry(entry)
+                    self._export_health()
+                    self._metrics["failovers"].inc()
                     self._backoff(attempts, deadline)
                     continue
-            if result.finish_reason == "aborted":
-                # the replica stopped/crashed with the request in flight —
-                # THE failover case; re-probe so routing reacts this round
-                self._probe_entry(entry)
-                self._export_health()
-                self._metrics["failovers"].inc()
-                self._backoff(attempts, deadline)
-                continue
-            self._metrics["latency"].observe(time.perf_counter() - t0)
-            self._metrics["attempts"].observe(attempts)
-            self._metrics["requests"].inc()
-            return result
+                _span_note(aspan, outcome="ok")
+                self._metrics["latency"].observe(time.perf_counter() - t0)
+                self._metrics["attempts"].observe(attempts)
+                self._metrics["requests"].inc()
+                return result
 
     # ----------------------------------------------------- rolling hot-swap
 

@@ -22,6 +22,8 @@ Endpoints:
     JSON: full metrics snapshot, phase breakdown, last dynamics summary.
 ``/trace``
     The flight-recorder ring as Chrome trace JSON (open in Perfetto).
+    ``?request_id=`` / ``?trace_id=`` filter the span events to one
+    request's trace — the live half of ``dktrace critical-path``.
 
 Handlers only *read* registry snapshots and the recorder ring (each guarded
 by its own cheap lock), so scraping never blocks the training loop.  The
@@ -36,6 +38,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs
 
 from distkeras_tpu.telemetry import runtime as _runtime
 from distkeras_tpu.telemetry.flightdeck import correlate
@@ -62,8 +65,8 @@ _LOCK = threading.Lock()
 
 # Extra endpoint registry: path -> handler.  Zero-arg handlers return
 # (content_type, body); handlers that accept an argument get a request dict
-# {"method", "query", "body"} and may return a (ctype, body, status) triple
-# (how the serving /generate endpoint speaks 400/503).
+# {"method", "query", "body", "headers"} and may return a (ctype, body,
+# status) triple (how the serving /generate endpoint speaks 400/503).
 _EXTRA: Dict[str, Callable] = {}
 
 
@@ -148,11 +151,12 @@ def add_endpoint(path: str, fn: Callable) -> None:
       fleet ``/aggregate``);
     * ``fn(request) -> (content_type, body[, status[, headers]])`` —
       request-aware: ``request`` is ``{"method": "GET"|"POST", "query":
-      <raw query string>, "body": <decoded POST body or "">}``, the
-      optional third element sets the HTTP status (the serving
-      ``/generate`` endpoint's 400/503/504), and the optional fourth is a
-      dict of extra response headers (e.g. ``Retry-After`` on a 503).
-      Request-aware endpoints also receive POSTs.
+      <raw query string>, "body": <decoded POST body or "">, "headers":
+      <lower-cased request-header dict>}``, the optional third element
+      sets the HTTP status (the serving ``/generate`` endpoint's
+      400/503/504), and the optional fourth is a dict of extra response
+      headers (e.g. ``Retry-After`` on a 503).  Request-aware endpoints
+      also receive POSTs.
     """
     _EXTRA[path] = fn
 
@@ -189,6 +193,24 @@ def _write_discovery_file() -> None:
 
 
 # ------------------------------------------------------------------ handler
+
+
+def _event_matches(event: dict, request_id: str, trace_id: str) -> bool:
+    """Does a trace event belong to the given request/trace?  Matches the
+    direct ``args.request_id``/``args.trace_id`` stamps and the batched
+    decode-step spellings (``args.requests`` list, ``args.trace_ids``)."""
+    args = event.get("args") or {}
+    if request_id:
+        if args.get("request_id") == request_id:
+            return True
+        if request_id in (args.get("requests") or ()):
+            return True
+    if trace_id:
+        if args.get("trace_id") == trace_id:
+            return True
+        if trace_id in (args.get("trace_ids") or ()):
+            return True
+    return False
 
 
 def _render(path: str, request: Optional[dict] = None):
@@ -232,6 +254,15 @@ def _render(path: str, request: Optional[dict] = None):
         return ("application/json", json.dumps(body), 200)
     if path == "/trace":
         payload = rec.trace_export(origin=_tracer._origin)
+        query = parse_qs((request or {}).get("query") or "")
+        want_rid = (query.get("request_id") or [""])[-1]
+        want_tid = (query.get("trace_id") or [""])[-1]
+        if want_rid or want_tid:
+            payload = dict(payload)
+            payload["traceEvents"] = [
+                e for e in payload.get("traceEvents", [])
+                if _event_matches(e, want_rid, want_tid)
+            ]
         return ("application/json", json.dumps(payload), 200)
     fn = _EXTRA.get(path)
     if fn is not None:
@@ -259,7 +290,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(405, "text/plain",
                             "POST only supported on registered endpoints")
                 return
-        request = {"method": method, "query": query, "body": body}
+        request = {
+            "method": method,
+            "query": query,
+            "body": body,
+            "headers": {k.lower(): v for k, v in self.headers.items()},
+        }
         try:
             payload = _render(path, request)
         except Exception as e:  # noqa: BLE001 — a scrape must never kill training

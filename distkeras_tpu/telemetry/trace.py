@@ -22,6 +22,15 @@ on exit — that is where bench.py's phase breakdown comes from.
 Exceptions raised while recording are NOT swallowed: the CI tier-1 variant
 with ``DISTKERAS_TELEMETRY=1`` exists precisely so instrumentation bugs fail
 the build instead of silently disabling observability.
+
+**Request tracing.**  A serving request crosses threads and processes
+(router dispatch thread → replica HTTP handler → engine loop), so thread
+nesting alone cannot stitch its spans together.  :meth:`Tracer.bind` binds a
+``trace_id``/``request_id`` context to the current thread; every span the
+thread records while bound carries those ids in its args (explicit span
+attrs win).  Threads that do work *for* a request without a bound context —
+the engine loop serves many requests per decode step — stamp the ids as
+explicit span args instead.  ``tools/dktrace critical-path`` joins on them.
 """
 
 from __future__ import annotations
@@ -30,13 +39,21 @@ import json
 import os
 import threading
 import time
+import uuid
 
 from distkeras_tpu.telemetry import runtime
 from distkeras_tpu.telemetry.flightdeck import correlate as _correlate
 from distkeras_tpu.telemetry.flightdeck.recorder import recorder as _flight_recorder
 from distkeras_tpu.telemetry.metrics import metrics as _registry
 
-__all__ = ["Span", "Tracer", "trace"]
+__all__ = ["NOOP_SPAN", "Span", "Tracer", "new_trace_id", "trace"]
+
+
+def new_trace_id() -> str:
+    """A fresh 32-hex trace id (the distributed-trace correlation key —
+    minted once at the first hop that sees the request, reused by every
+    later hop)."""
+    return uuid.uuid4().hex
 
 
 class _NoopSpan:
@@ -52,6 +69,28 @@ class _NoopSpan:
 
 
 NOOP_SPAN = _NoopSpan()
+
+
+class _ContextBinding:
+    """Context manager installing a trace context on the current thread;
+    restores the previous binding on exit (bindings nest — an inner bind
+    layers over, and restores, the outer one)."""
+
+    __slots__ = ("_tracer", "_ctx", "_prev")
+
+    def __init__(self, tracer, ctx):
+        self._tracer = tracer
+        self._ctx = ctx
+
+    def __enter__(self):
+        tls = self._tracer._tls
+        self._prev = getattr(tls, "ctx", None)
+        tls.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, exc_type, exc, tb):
+        self._tracer._tls.ctx = self._prev
+        return False
 
 
 class Span:
@@ -131,9 +170,48 @@ class Tracer:
         stack = getattr(self._tls, "stack", None)
         return stack[-1] if stack else None
 
+    # ------------------------------------------------------- trace context
+
+    def bind(self, trace_id=None, request_id=None, **extra):
+        """Bind a trace context to the current thread for the duration of a
+        ``with`` block.  Every span recorded by this thread while bound
+        carries the bound ids in its event args (explicit span attrs win
+        over the context).  Falsy values are skipped, so
+        ``bind(trace_id=req.trace_id)`` is safe when the id may be empty.
+
+        Works whether or not telemetry is enabled — binding is a couple of
+        thread-local writes; it is the spans that no-op when disabled."""
+        ctx = dict(getattr(self._tls, "ctx", None) or {})
+        if trace_id:
+            ctx["trace_id"] = trace_id
+        if request_id:
+            ctx["request_id"] = request_id
+        for key, value in extra.items():
+            if value:
+                ctx[key] = value
+        return _ContextBinding(self, ctx)
+
+    def context(self) -> dict:
+        """A copy of the current thread's bound trace context (``{}`` when
+        unbound) — e.g. ``trace.context().get("trace_id")``."""
+        return dict(getattr(self._tls, "ctx", None) or {})
+
+    def record(self, name, t0, t1, **attrs):
+        """Record an already-timed span (``perf_counter`` endpoints) without
+        entering a context manager — for threads attributing work that began
+        elsewhere, like the engine loop recording a request's queue wait
+        from its admission-thread enqueue timestamp."""
+        if not runtime.enabled():
+            return
+        self._record(name, t0, t1, None, attrs)
+
     def _record(self, name, t0, t1, parent, attrs):
         ident = threading.get_ident()
         args = dict(attrs)
+        ctx = getattr(self._tls, "ctx", None)
+        if ctx:
+            for key, value in ctx.items():
+                args.setdefault(key, value)
         if parent is not None:
             args["parent"] = parent
         if self._correlated:

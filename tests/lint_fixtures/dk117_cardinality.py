@@ -1,0 +1,43 @@
+"""DK117 fixture — per-request IDs leaking into metric names/labels.
+
+Package-scoped rule: the test copies this file into a synthetic
+``distkeras_tpu`` package under tmp_path.  Keep edits append-only or
+update the test.
+"""
+
+
+def leaky(registry, req, rid):
+    # 1. f-string metric name interpolating request_id
+    registry.counter(f"requests_{req.request_id}_total", help="per-request!")
+    # 2. % composition with a trace_id variable
+    trace_id = req.trace_id
+    registry.gauge("inflight_%s" % trace_id, help="per-trace!")
+    # 3. .format() with job_id attribute
+    registry.histogram("latency_{}".format(req.job_id), help="per-job!")
+    # 4. labels= dict with a request_id KEY
+    registry.to_prometheus(labels={"request_id": rid})
+    # 5. labels= dict whose VALUE reads trace_id
+    registry.to_prometheus(labels={"req": req.trace_id})
+    # 6. labels= as a non-dict expression reading an id
+    registry.to_prometheus(labels=make_labels(req.request_id))
+    return registry
+
+
+def make_labels(rid):
+    return {"rid": rid}
+
+
+def clean(registry, trace, req, run_id):
+    # literal names are always fine (DK114 owns literal hygiene)
+    registry.counter("requests_total", help="bounded")
+    # a *family* interpolation over a bounded enum is fine
+    for kind in ("hedge", "failover"):
+        registry.counter(f"retries_{kind}_total", help="bounded family")
+    # run_id is a per-fleet label, not per-request: fine
+    registry.to_prometheus(labels={"run_id": run_id})
+    # trace-span args are the sanctioned home for request ids
+    with trace.span("serving.admit", request_id=req.request_id,
+                    trace_id=req.trace_id):
+        pass
+    trace.record("serving.queue_wait", 0.0, 1.0, request_id=req.request_id)
+    return registry

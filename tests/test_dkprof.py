@@ -1,0 +1,342 @@
+"""dkprof unit suite — xplane/Chrome parsing, op grouping, budget math,
+meta-driven MFU/roofline, trace discovery, and the compare gate.
+
+The miniature ``tests/golden/dkprof_mini.xplane.pb`` is built by the
+same wire-format encoder embedded here (:func:`_mini_xplane_bytes`), and
+one test asserts the checked-in bytes match — regenerate with::
+
+    python -c "import tests.test_dkprof as t; \
+open('tests/golden/dkprof_mini.xplane.pb','wb').write(t._mini_xplane_bytes())"
+"""
+
+import gzip
+import json
+import os
+import shutil
+
+import pytest
+
+from tools.dkprof import (
+    build_report,
+    classify_op,
+    compare_reports,
+    find_trace,
+    load_op_events,
+    op_budget,
+    parse_chrome_trace,
+    parse_xplane,
+    render_markdown,
+)
+from tools.dkprof.__main__ import main as dkprof_main
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+MINI_PB = os.path.join(GOLDEN, "dkprof_mini.xplane.pb")
+MINI_CHROME = os.path.join(GOLDEN, "dkprof_mini.trace.json")
+
+
+# ---------------------------------------------------------------- encoder
+
+def _varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _field(num, wire, payload):
+    tag = _varint((num << 3) | wire)
+    if wire == 2:
+        return tag + _varint(len(payload)) + payload
+    return tag + _varint(payload)
+
+
+def _msg(*fields):
+    return b"".join(fields)
+
+
+def _event_meta_entry(mid, name):
+    # XPlane.event_metadata is map<int64, XEventMetadata>: entry{1:k, 2:v}
+    inner = _msg(_field(1, 0, mid), _field(2, 2, name.encode()))
+    return _field(4, 2, _msg(_field(1, 0, mid), _field(2, 2, inner)))
+
+
+def _event(mid, offset_ps, dur_ps, occ=1):
+    body = _msg(_field(1, 0, mid), _field(2, 0, offset_ps),
+                _field(3, 0, dur_ps))
+    if occ != 1:
+        body += _field(5, 0, occ)
+    return body
+
+
+#: metadata_id -> op name for the miniature device plane
+_MINI_METAS = {
+    1: "dot.1",
+    2: "broadcast_add_fusion",
+    3: "reduce.3",
+    4: "copy.4",
+    5: "%while.body",
+    6: "ThunkExecutor::Execute",
+    7: "all-reduce.7",
+}
+
+#: (metadata_id, offset_ps, duration_ps, num_occurrences)
+_MINI_EVENTS = (
+    (1, 0, 400_000_000, 4),            # matmul      0.400 ms
+    (2, 400_000_000, 200_000_000, 4),  # fusion      0.200 ms
+    (3, 600_000_000, 100_000_000, 4),  # reduction   0.100 ms
+    (4, 700_000_000, 50_000_000, 4),   # data-move   0.050 ms
+    (7, 750_000_000, 25_000_000, 4),   # collective  0.025 ms
+    (5, 0, 775_000_000, 1),            # while parent: excluded
+    (6, 0, 900_000_000, 1),            # infra frame: excluded
+)
+
+MINI_TOTAL_MS = 0.775
+MINI_GROUPS_MS = {"matmul": 0.4, "fusion": 0.2, "reduction": 0.1,
+                  "data-movement": 0.05, "collective": 0.025}
+
+
+def _mini_xplane_bytes():
+    line = _msg(_field(1, 0, 1), _field(2, 2, b"XLA Ops"),
+                *[_field(4, 2, _event(*e)) for e in _MINI_EVENTS])
+    plane = _msg(_field(1, 0, 1), _field(2, 2, b"/device:TPU:0"),
+                 _field(3, 2, line),
+                 *[_event_meta_entry(mid, name)
+                   for mid, name in _MINI_METAS.items()])
+    # a quieter host plane that must lose the plane election
+    host_line = _msg(_field(2, 2, b"python-main"),
+                     _field(4, 2, _event(1, 0, 1_000_000)))
+    host = _msg(_field(2, 2, b"/host:CPU"), _field(3, 2, host_line),
+                _event_meta_entry(1, "hostcall"))
+    return _field(1, 2, plane) + _field(1, 2, host)
+
+
+# ------------------------------------------------------------ parse layer
+
+def test_mini_fixture_matches_encoder():
+    with open(MINI_PB, "rb") as fh:
+        assert fh.read() == _mini_xplane_bytes()
+
+
+def test_parse_xplane_planes_lines_events():
+    planes = parse_xplane(_mini_xplane_bytes())
+    assert [p["name"] for p in planes] == ["/device:TPU:0", "/host:CPU"]
+    device = planes[0]
+    assert [ln["name"] for ln in device["lines"]] == ["XLA Ops"]
+    events = device["lines"][0]["events"]
+    assert len(events) == len(_MINI_EVENTS)
+    by_name = {e["name"]: e for e in events}
+    assert by_name["dot.1"]["duration_ps"] == 400_000_000
+    assert by_name["dot.1"]["num_occurrences"] == 4
+    assert by_name["%while.body"]["num_occurrences"] == 1
+
+
+def test_parse_xplane_rejects_garbage():
+    with pytest.raises(ValueError):
+        # wire type 7 is unused by protobuf — decoder must not guess
+        parse_xplane(bytes([0x0F, 0x00]))
+
+
+def test_parse_chrome_trace_filters_and_scales():
+    events = parse_chrome_trace(MINI_CHROME)
+    # "M" metadata rows and zero-duration rows dropped; "X" rows kept
+    names = sorted(e["name"] for e in events)
+    assert names == sorted(["dot.1", "broadcast_add_fusion", "reduce.3",
+                            "%while.body", "TaskDispatcher::dispatch"])
+    dot = next(e for e in events if e["name"] == "dot.1")
+    assert dot["duration_ps"] == 100_000_000  # 100 us -> ps
+
+
+def test_parse_chrome_trace_gz(tmp_path):
+    gz = tmp_path / "mini.trace.json.gz"
+    with open(MINI_CHROME, "rb") as src, gzip.open(gz, "wb") as dst:
+        dst.write(src.read())
+    assert parse_chrome_trace(str(gz)) == parse_chrome_trace(MINI_CHROME)
+
+
+# ---------------------------------------------------------------- budget
+
+def test_classify_op_groups():
+    assert classify_op("dot.5") == "matmul"
+    assert classify_op("%convolution.2") == "matmul"
+    assert classify_op("all-reduce.1") == "collective"
+    assert classify_op("reduce-window.3") == "reduction"
+    assert classify_op("reduce.3") == "reduction"
+    assert classify_op("rng-bit-generator") == "rng"
+    assert classify_op("copy.4") == "data-movement"
+    assert classify_op("custom-call.9") == "other"
+    # fusions keep their group no matter which root op names them
+    assert classify_op("broadcast_maximum_fusion") == "fusion"
+    assert classify_op("loop_fusion.3") == "fusion"
+    # excluded: infra frames and while-loop parents (PERF.md double-count)
+    assert classify_op("ThunkExecutor::Execute") is None
+    assert classify_op("%while.body") is None
+    assert classify_op("") is None
+
+
+def test_op_budget_mini():
+    events, fmt, plane = load_op_events(MINI_PB)
+    assert (fmt, plane) == ("xplane", "/device:TPU:0")
+    budget = op_budget(events)
+    assert budget["total_ms"] == pytest.approx(MINI_TOTAL_MS)
+    got = {g["group"]: g["time_ms"] for g in budget["groups"]}
+    assert got == pytest.approx(MINI_GROUPS_MS)
+    # rows sorted by time, pct sums to ~100, counts carried through
+    assert [g["group"] for g in budget["groups"]] == [
+        "matmul", "fusion", "reduction", "data-movement", "collective"]
+    assert sum(g["pct"] for g in budget["groups"]) == pytest.approx(100, 0.01)
+    assert budget["groups"][0]["count"] == 4
+    assert budget["groups"][0]["ops"][0]["name"] == "dot.1"
+
+
+def test_op_budget_meta_mfu_roofline():
+    events, _, _ = load_op_events(MINI_PB)
+    meta = {
+        "peak_flops": 100e12,
+        "peak_bw": 1e12,        # ridge = 100 FLOP/byte
+        "total_flops": 38.75e9,  # over 0.775 ms -> MFU 0.5 at 100 TFLOP/s
+        "flops": {"matmul": 20e6, "fusion": 1e6},
+        "bytes": {"matmul": 1e3, "fusion": 1e6, "data-movement": 5e4},
+    }
+    budget = op_budget(events, meta)
+    rows = {g["group"]: g for g in budget["groups"]}
+    mm = rows["matmul"]  # 20e6 FLOP / 0.4 ms = 50 GFLOP/s
+    assert mm["achieved_tflops"] == pytest.approx(50e9 / 1e12)
+    assert mm["mfu"] == pytest.approx(50e9 / 100e12, abs=1e-6)
+    assert mm["roofline"] == "compute-bound"   # 20e3 FLOP/byte >= 100
+    assert rows["fusion"]["roofline"] == "hbm-bound"  # 1 FLOP/byte
+    assert rows["data-movement"]["roofline"] == "hbm-bound"  # bytes only
+    assert "roofline" not in rows["reduction"]  # no meta coverage
+    assert budget["mfu"] == pytest.approx(0.5, abs=1e-4)
+
+
+# ---------------------------------------------------------- report layer
+
+def test_find_trace_prefers_xplane_in_profile_layout(tmp_path):
+    logdir = tmp_path / "prof"
+    tsdir = logdir / "plugins" / "profile" / "2026_08_06_00_00_00"
+    tsdir.mkdir(parents=True)
+    shutil.copy(MINI_PB, tsdir / "host.xplane.pb")
+    shutil.copy(MINI_CHROME, tsdir / "host.trace.json")
+    assert find_trace(str(logdir)) == str(tsdir / "host.xplane.pb")
+    assert find_trace(str(tsdir)) == str(tsdir / "host.xplane.pb")
+    assert find_trace(MINI_PB) == MINI_PB  # files pass through
+
+
+def test_find_trace_empty_dir_raises(tmp_path):
+    with pytest.raises(ValueError):
+        find_trace(str(tmp_path))
+
+
+def test_build_report_reads_meta_sidecar(tmp_path):
+    tsdir = tmp_path / "plugins" / "profile" / "t0"
+    tsdir.mkdir(parents=True)
+    shutil.copy(MINI_PB, tsdir / "host.xplane.pb")
+    # sidecar at the logdir root, three levels above the artifact
+    (tmp_path / "dkprof_meta.json").write_text(
+        json.dumps({"peak_flops": 100e12, "total_flops": 38.75e9}))
+    report = build_report(str(tmp_path))
+    assert report["format"] == "xplane"
+    assert report["plane"] == "/device:TPU:0"
+    assert report["peak_flops"] == 100e12
+    assert report["mfu"] == pytest.approx(0.5, abs=1e-4)
+
+
+def test_render_markdown_shape():
+    text = render_markdown(build_report(MINI_PB))
+    assert "# dkprof report" in text
+    assert "| Group | ms | % |" in text
+    assert "| matmul | 0.400 | 51.6 " in text
+    assert "`dot.1`" in text
+
+
+# ----------------------------------------------------------- compare gate
+
+def _report(groups, total=None):
+    rows = [{"group": g, "time_ms": ms} for g, ms in groups.items()]
+    return {"total_ms": total if total is not None else sum(groups.values()),
+            "groups": rows}
+
+
+def test_compare_ok_within_budget():
+    old = _report({"matmul": 1.0, "fusion": 0.5})
+    new = _report({"matmul": 1.04, "fusion": 0.5})
+    verdict = compare_reports(old, new, budget_pct=10)
+    assert verdict["ok"] and not verdict["regressions"]
+
+
+def test_compare_flags_group_and_total():
+    old = _report({"matmul": 1.0, "fusion": 0.5})
+    new = _report({"matmul": 1.5, "fusion": 0.5})
+    verdict = compare_reports(old, new, budget_pct=10)
+    assert not verdict["ok"]
+    assert {r["group"] for r in verdict["regressions"]} == \
+        {"<total>", "matmul"}
+    mm = next(r for r in verdict["regressions"] if r["group"] == "matmul")
+    assert mm["ratio"] == pytest.approx(1.5)
+
+
+def test_compare_noise_floor_and_new_group():
+    old = _report({"matmul": 1.0, "rng": 0.001})
+    # rng stays under min_ms in both -> never gates even at 10x
+    new = _report({"matmul": 1.0, "rng": 0.01}, total=1.0)
+    assert compare_reports(old, new, budget_pct=5)["ok"]
+    # a brand-new group gates once it clears the floor
+    new2 = _report({"matmul": 1.0, "collective": 0.4}, total=1.0)
+    verdict = compare_reports(old, new2, budget_pct=5)
+    assert [r["group"] for r in verdict["regressions"]] == ["collective"]
+    assert verdict["regressions"][0]["ratio"] is None
+
+
+def test_compare_reports_improvements():
+    old = _report({"matmul": 2.0})
+    new = _report({"matmul": 1.0})
+    verdict = compare_reports(old, new, budget_pct=10)
+    assert verdict["ok"]
+    assert {i["group"] for i in verdict["improvements"]} == \
+        {"<total>", "matmul"}
+
+
+def test_compare_rejects_negative_budget():
+    with pytest.raises(ValueError):
+        compare_reports(_report({}), _report({}), budget_pct=-1)
+
+
+# -------------------------------------------------------------------- CLI
+
+def test_cli_report_json_and_markdown(tmp_path, capsys):
+    out = tmp_path / "report.json"
+    assert dkprof_main(["report", MINI_PB, "--json", str(out)]) == 0
+    report = json.loads(out.read_text())
+    assert report["total_ms"] == pytest.approx(MINI_TOTAL_MS)
+    assert dkprof_main(["report", MINI_PB]) == 0  # markdown to stdout
+    assert "| Group | ms | % |" in capsys.readouterr().out
+
+
+def test_cli_report_missing_trace_exit_2(tmp_path, capsys):
+    assert dkprof_main(["report", str(tmp_path / "nope")]) == 2
+    assert "error" in capsys.readouterr().err
+
+
+def test_cli_compare_gate_exit_codes(tmp_path, capsys):
+    base = tmp_path / "base.json"
+    assert dkprof_main(["report", MINI_PB, "--json", str(base)]) == 0
+    # identical sides: ok
+    assert dkprof_main(
+        ["compare", str(base), MINI_PB, "--budget", "5"]) == 0
+    # synthetically inflate one group past the budget -> exit 3
+    report = json.loads(base.read_text())
+    for g in report["groups"]:
+        if g["group"] == "matmul":
+            g["time_ms"] *= 1.25
+    report["total_ms"] = sum(g["time_ms"] for g in report["groups"])
+    inflated = tmp_path / "inflated.json"
+    inflated.write_text(json.dumps(report))
+    assert dkprof_main(
+        ["compare", str(base), str(inflated), "--budget", "5"]) == 3
+    assert "REGRESSED matmul" in capsys.readouterr().out
+    # unreadable operand -> input error, not regression
+    assert dkprof_main(
+        ["compare", str(base), str(tmp_path / "gone"), "--budget", "5"]) == 2

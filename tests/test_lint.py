@@ -450,6 +450,32 @@ def test_dk116_out_of_scope_module_is_silent(tmp_path):
     assert findings == []
 
 
+def test_dk117_cardinality_fixture(tmp_path):
+    assert _run_in_package(
+        tmp_path, "dk117_cardinality.py", ["DK117"]
+    ) == [
+        ("DK117", 11),  # f-string metric name interpolating request_id
+        ("DK117", 14),  # % composition with a trace_id variable
+        ("DK117", 16),  # .format() with a job_id attribute
+        ("DK117", 18),  # labels= dict with a request_id key
+        ("DK117", 20),  # labels= dict value reading trace_id
+        ("DK117", 22),  # labels= expression reading request_id
+    ]
+
+
+def test_dk117_sanctioned_homes_are_silent(tmp_path):
+    """Literal names, bounded-enum families, run_id labels, and trace-span
+    args (the sanctioned home for request ids) all stay unflagged."""
+    lines = [ln for _, ln in _run_in_package(
+        tmp_path, "dk117_cardinality.py", ["DK117"])]
+    assert all(ln < 26 for ln in lines), lines  # everything in clean() silent
+
+
+def test_dk117_out_of_package_is_silent():
+    got, _ = _run("dk117_cardinality.py", ["DK117"])
+    assert got == []
+
+
 def test_dk115_out_of_scope_module_is_silent(tmp_path):
     """Same code outside the daemon/server scope stays unflagged — batch
     code may legitimately block forever."""
@@ -577,7 +603,7 @@ def test_all_rules_registered():
     assert sorted(all_rules()) == [
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
         "DK108", "DK109", "DK110", "DK111", "DK112", "DK113", "DK114",
-        "DK115", "DK116",
+        "DK115", "DK116", "DK117",
     ]
 
 

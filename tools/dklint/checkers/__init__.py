@@ -2,6 +2,7 @@
 
 from tools.dklint.checkers import (  # noqa: F401 — registration side effects
     blocking,
+    cardinality,
     collectives,
     daemon_protocol,
     donation,

@@ -1,0 +1,150 @@
+"""DK117 — unbounded-cardinality metric labels.
+
+Prometheus-style metrics are aggregates: every distinct (name, label-set)
+is its own time series held forever by the registry, the scraper, and the
+fleet merge.  Stamping a *per-request* identifier — ``request_id``,
+``trace_id``, ``job_id`` — into a metric name or label set therefore
+creates one immortal series per request: memory grows without bound, the
+``/metrics`` page becomes a request log, and dashboards aggregate over
+nothing.  Per-request IDs belong in **trace-span args** (where
+``dktrace critical-path`` joins on them) and structured logs, never in
+metrics.
+
+Flagged, package-scoped (``distkeras_tpu``):
+
+* a metric registration (``*.counter/gauge/histogram(...)``) whose *name*
+  argument is computed from an ID — f-string interpolation, ``%`` / ``+``
+  / ``.format()`` composition — e.g.
+  ``registry.counter(f"requests_{req.request_id}")``;
+* a ``labels=`` dict whose **keys** include an ID name, or whose values
+  read an ID variable/attribute — e.g.
+  ``to_prometheus(labels={"request_id": rid})``.
+
+Literal metric names can't embed a per-request value, so they are always
+clean here (DK114 owns literal-name hygiene); trace-span calls are not
+metric calls and are untouched — they are the sanctioned home.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project
+from tools.dklint.registry import register
+
+METRIC_KINDS = frozenset({"counter", "gauge", "histogram"})
+
+#: identifiers whose value space is one-per-request/run — unbounded
+ID_NAMES = frozenset({"request_id", "trace_id", "job_id"})
+
+
+def _id_reference(node: ast.AST) -> Optional[str]:
+    """The per-request ID name this expression reads, if any —
+    ``request_id``, ``req.request_id``, ``self._trace_id``, ... (an
+    underscore-prefixed spelling still counts)."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name is None:
+            continue
+        bare = name.lstrip("_")
+        if bare in ID_NAMES:
+            return bare
+    return None
+
+
+def _computed_name_id(arg: ast.AST) -> Optional[str]:
+    """ID referenced by a *computed* metric-name expression (literal
+    constants can't embed a per-request value)."""
+    if isinstance(arg, ast.Constant):
+        return None
+    if isinstance(arg, ast.JoinedStr):
+        for value in arg.values:
+            if isinstance(value, ast.FormattedValue):
+                hit = _id_reference(value.value)
+                if hit:
+                    return hit
+        return None
+    if isinstance(arg, (ast.BinOp, ast.Call)):
+        # "requests_" + rid / "requests_%s" % rid / "...".format(rid)
+        return _id_reference(arg)
+    return None
+
+
+@register
+class CardinalityChecker(Checker):
+    rule = "DK117"
+    name = "metric-label-cardinality"
+    description = (
+        "per-request IDs (request_id/trace_id/job_id) used as a metric "
+        "label or metric-name component — one immortal series per request"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        mod = fi.module or ""
+        if mod != "distkeras_tpu" and not mod.startswith("distkeras_tpu."):
+            return
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            yield from self._check_call(fi, node)
+
+    def _check_call(self, fi: FileInfo, node: ast.Call) -> Iterable[Finding]:
+        # (1) computed metric *name* embedding an ID
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in METRIC_KINDS and node.args:
+            hit = _computed_name_id(node.args[0])
+            if hit:
+                yield self._finding(
+                    fi, node.args[0],
+                    f"metric name is computed from per-request "
+                    f"'{hit}' — every request mints a new immortal time "
+                    "series; put the id in trace-span args instead",
+                )
+        # (2) labels= carrying an ID as key or value
+        for kw in node.keywords:
+            if kw.arg != "labels":
+                continue
+            if isinstance(kw.value, ast.Dict):
+                for key, value in zip(kw.value.keys, kw.value.values):
+                    if isinstance(key, ast.Constant) \
+                            and isinstance(key.value, str) \
+                            and key.value.lstrip("_") in ID_NAMES:
+                        yield self._finding(
+                            fi, key,
+                            f"metric label key '{key.value}' is a "
+                            "per-request id — unbounded label "
+                            "cardinality; span args are the sanctioned "
+                            "home for request ids",
+                        )
+                        continue
+                    hit = _id_reference(value) if value is not None else None
+                    if hit:
+                        yield self._finding(
+                            fi, value,
+                            f"metric label value reads per-request "
+                            f"'{hit}' — unbounded label cardinality; "
+                            "span args are the sanctioned home",
+                        )
+            else:
+                hit = _id_reference(kw.value)
+                if hit:
+                    yield self._finding(
+                        fi, kw.value,
+                        f"labels= expression reads per-request '{hit}' — "
+                        "unbounded label cardinality; span args are the "
+                        "sanctioned home",
+                    )
+
+    def _finding(self, fi: FileInfo, node: ast.AST, why: str) -> Finding:
+        return Finding(
+            path=fi.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            rule=self.rule,
+            message=f"metric cardinality: {why}",
+        )

@@ -1,0 +1,34 @@
+"""dkprof — durable profile attribution for ``jax.profiler`` captures.
+
+PERF.md's op budget was produced with throwaway scripts; dkprof is the
+durable replacement.  It parses the artifacts a ``DISTKERAS_PROFILE``
+window leaves behind — the ``*.xplane.pb`` protobuf (decoded with a
+self-contained wire-format reader, no tensorflow/protobuf dependency) or
+a Chrome ``*.trace.json[.gz]`` — into the PERF.md-style budget: per-op-
+group device time and share, achieved-vs-peak FLOP/s, HBM roofline
+classification, and MFU (the FLOP/byte counts come from an optional meta
+sidecar; time attribution needs none).
+
+``python -m tools.dkprof report <trace>`` emits the budget as JSON or
+markdown; ``python -m tools.dkprof compare A B --budget <pct>`` exits
+nonzero when B regresses A beyond the budget — the machine-checkable perf
+gate bench.py and CI use instead of trusting verdict strings.
+"""
+
+from tools.dkprof.budget import classify_op, op_budget
+from tools.dkprof.chrome import parse_chrome_trace
+from tools.dkprof.compare import compare_reports
+from tools.dkprof.report import build_report, find_trace, load_op_events, render_markdown
+from tools.dkprof.xplane import parse_xplane
+
+__all__ = [
+    "build_report",
+    "classify_op",
+    "compare_reports",
+    "find_trace",
+    "load_op_events",
+    "op_budget",
+    "parse_chrome_trace",
+    "parse_xplane",
+    "render_markdown",
+]

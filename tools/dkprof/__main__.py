@@ -1,0 +1,138 @@
+"""CLI: ``python -m tools.dkprof report TRACE [...]`` and
+``python -m tools.dkprof compare OLD NEW --budget PCT``.
+
+``report`` resolves a trace (file, timestamp dir, or ``DISTKERAS_PROFILE``
+logdir) into the op budget, printed as markdown by default, ``--json``
+for machines.  ``compare`` accepts either report-JSON files or traces for
+each side and exits **3** when NEW regresses OLD beyond the budget — the
+exit code CI's perf gate keys on (2 stays "input error", mirroring
+dktrace).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.dkprof.compare import compare_reports
+from tools.dkprof.report import build_report, render_markdown
+
+
+def _load_side(path: str) -> dict:
+    """A compare operand: a ``report --json`` file (recognised by its
+    ``groups`` key) or anything ``build_report`` can resolve."""
+    if os.path.isfile(path) and path.endswith(".json"):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                payload = json.load(fh)
+            if isinstance(payload, dict) and "groups" in payload:
+                return payload
+        except ValueError:
+            pass
+    return build_report(path)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.dkprof",
+        description="profile attribution + perf gating for jax.profiler "
+                    "captures (DISTKERAS_PROFILE windows)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    rep = sub.add_parser(
+        "report", help="attribute a trace into the PERF.md-style op budget")
+    rep.add_argument("trace", metavar="TRACE",
+                     help="trace file (*.xplane.pb / *.trace.json[.gz]) or "
+                          "a profile logdir containing one")
+    rep.add_argument("--json", dest="json_out", metavar="OUT", default=None,
+                     help="write the report JSON here ('-' for stdout)")
+    rep.add_argument("--markdown", dest="md_out", metavar="OUT", default=None,
+                     help="write the markdown report here ('-' for stdout; "
+                          "default when no output is chosen)")
+    rep.add_argument("--meta", default=None,
+                     help="meta sidecar JSON (peak_flops, peak_bw, "
+                          "total_flops, per-group flops/bytes); default: "
+                          "dkprof_meta.json next to the trace")
+    rep.add_argument("--peak-flops", type=float, default=None,
+                     help="override peak FLOP/s (default 197e12, TPU v5e)")
+    rep.add_argument("--peak-bw", type=float, default=None,
+                     help="override peak HBM B/s (default 819e9)")
+
+    cmp_ = sub.add_parser(
+        "compare", help="gate NEW against OLD with a regression budget")
+    cmp_.add_argument("old", metavar="OLD",
+                      help="baseline: report JSON or trace")
+    cmp_.add_argument("new", metavar="NEW",
+                      help="candidate: report JSON or trace")
+    cmp_.add_argument("--budget", type=float, required=True, metavar="PCT",
+                      help="allowed growth in percent before the gate trips")
+    cmp_.add_argument("--min-ms", type=float, default=0.05,
+                      help="noise floor: groups below this in both reports "
+                           "never gate (default 0.05)")
+    cmp_.add_argument("--json", action="store_true", dest="as_json",
+                      help="emit the verdict as JSON")
+    args = parser.parse_args(argv)
+
+    if args.cmd == "report":
+        meta = {}
+        if args.peak_flops:
+            meta["peak_flops"] = args.peak_flops
+        if args.peak_bw:
+            meta["peak_bw"] = args.peak_bw
+        try:
+            report = build_report(args.trace, meta=meta, meta_path=args.meta)
+        except ValueError as e:
+            print(f"dkprof: error: {e}", file=sys.stderr)
+            return 2
+        wrote = False
+        if args.json_out:
+            text = json.dumps(report, indent=1)
+            if args.json_out == "-":
+                print(text)
+            else:
+                with open(args.json_out, "w", encoding="utf-8") as fh:
+                    fh.write(text + "\n")
+                print(f"dkprof: wrote {args.json_out}", file=sys.stderr)
+            wrote = True
+        if args.md_out or not wrote:
+            text = render_markdown(report)
+            out = args.md_out or "-"
+            if out == "-":
+                print(text)
+            else:
+                with open(out, "w", encoding="utf-8") as fh:
+                    fh.write(text)
+                print(f"dkprof: wrote {out}", file=sys.stderr)
+        return 0
+
+    # compare
+    try:
+        old = _load_side(args.old)
+        new = _load_side(args.new)
+        verdict = compare_reports(old, new, args.budget, min_ms=args.min_ms)
+    except ValueError as e:
+        print(f"dkprof: error: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(verdict, indent=1))
+    else:
+        status = "OK" if verdict["ok"] else "REGRESSION"
+        print(f"dkprof compare: {status} "
+              f"(total {verdict['old_total_ms']:.3f} -> "
+              f"{verdict['new_total_ms']:.3f} ms, budget "
+              f"{args.budget:g}%)")
+        for r in verdict["regressions"]:
+            ratio = f"{r['ratio']:.2f}x" if r.get("ratio") else "new"
+            print(f"  REGRESSED {r['group']}: {r['old_ms']:.3f} -> "
+                  f"{r['new_ms']:.3f} ms ({ratio})")
+        for i in verdict["improvements"]:
+            print(f"  improved  {i['group']}: {i['old_ms']:.3f} -> "
+                  f"{i['new_ms']:.3f} ms ({i['ratio']:.2f}x)")
+    return 0 if verdict["ok"] else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""Chrome trace-event input for dkprof.
+
+``jax.profiler`` drops a ``*.trace.json.gz`` next to the xplane protobuf;
+TPU captures put the XLA op timeline on ``/device:TPU:*`` process tracks,
+while CPU captures bury it in host-side C++ infra events.  This parser
+extracts complete ("ph" == "X") events, keeping the same op-name filters
+the xplane path applies, so both formats feed :mod:`tools.dkprof.budget`
+identically (durations normalised to picoseconds).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+from typing import List
+
+__all__ = ["parse_chrome_trace"]
+
+
+def parse_chrome_trace(path: str) -> List[dict]:
+    """``[{"name", "duration_ps", "num_occurrences"}, ...]`` from a Chrome
+    trace JSON file (``.gz`` transparently decompressed)."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    events = payload.get("traceEvents", payload if isinstance(payload, list)
+                         else [])
+    out = []
+    for e in events:
+        if not isinstance(e, dict) or e.get("ph") != "X":
+            continue
+        name = e.get("name") or ""
+        dur_us = float(e.get("dur") or 0.0)
+        if not name or dur_us <= 0:
+            continue
+        out.append({
+            "name": name,
+            "duration_ps": int(dur_us * 1e6),
+            "num_occurrences": 1,
+        })
+    return out

@@ -1,0 +1,81 @@
+"""The perf gate: compare two dkprof reports against a regression budget.
+
+``compare_reports(old, new, budget_pct)`` flags a regression when the
+total attributed op time — or any group above the noise floor — grows by
+more than ``budget_pct`` percent.  Inputs are report dicts (from
+:func:`tools.dkprof.report.build_report` or a ``report --json`` file),
+so the gate works identically on fresh traces and checked-in baselines
+like ``bench_baseline.json`` pointers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["compare_reports"]
+
+
+def compare_reports(old: dict, new: dict, budget_pct: float,
+                    min_ms: float = 0.05) -> dict:
+    """``{"ok": bool, "regressions": [...], "improvements": [...]}``.
+
+    A group below ``min_ms`` in BOTH reports is noise and never gates;
+    a group present only in ``new`` gates once it clears ``min_ms``.
+    """
+    if budget_pct < 0:
+        raise ValueError(f"budget_pct must be >= 0, got {budget_pct}")
+    allowed = 1.0 + budget_pct / 100.0
+    old_groups: Dict[str, float] = {
+        g["group"]: float(g["time_ms"]) for g in old.get("groups", [])}
+    new_groups: Dict[str, float] = {
+        g["group"]: float(g["time_ms"]) for g in new.get("groups", [])}
+
+    regressions = []
+    improvements = []
+
+    old_total = float(old.get("total_ms") or 0.0)
+    new_total = float(new.get("total_ms") or 0.0)
+    if old_total > 0 and new_total > old_total * allowed:
+        regressions.append({
+            "group": "<total>",
+            "old_ms": round(old_total, 6),
+            "new_ms": round(new_total, 6),
+            "ratio": round(new_total / old_total, 4),
+        })
+    elif old_total > 0 and new_total < old_total / allowed:
+        improvements.append({
+            "group": "<total>",
+            "old_ms": round(old_total, 6),
+            "new_ms": round(new_total, 6),
+            "ratio": round(new_total / old_total, 4),
+        })
+
+    for group in sorted(set(old_groups) | set(new_groups)):
+        was = old_groups.get(group, 0.0)
+        now = new_groups.get(group, 0.0)
+        if was < min_ms and now < min_ms:
+            continue
+        if now > max(was, min_ms) * allowed:
+            regressions.append({
+                "group": group,
+                "old_ms": round(was, 6),
+                "new_ms": round(now, 6),
+                "ratio": round(now / was, 4) if was else None,
+            })
+        elif was > 0 and now < was / allowed:
+            improvements.append({
+                "group": group,
+                "old_ms": round(was, 6),
+                "new_ms": round(now, 6),
+                "ratio": round(now / was, 4),
+            })
+
+    return {
+        "ok": not regressions,
+        "budget_pct": budget_pct,
+        "min_ms": min_ms,
+        "old_total_ms": round(old_total, 6),
+        "new_total_ms": round(new_total, 6),
+        "regressions": regressions,
+        "improvements": improvements,
+    }

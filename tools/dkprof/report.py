@@ -1,0 +1,153 @@
+"""Trace discovery, report building, and markdown rendering.
+
+A ``DISTKERAS_PROFILE=<dir>`` window leaves
+``<dir>/plugins/profile/<timestamp>/<host>.xplane.pb`` (+ a Chrome
+``.trace.json.gz`` sibling).  :func:`find_trace` resolves whatever the
+user points at — the logdir, the timestamp dir, or a concrete file — to
+the best artifact (xplane preferred: on CPU captures the Chrome export
+is host-Python noise while the xplane still carries the real XLA op
+line).  :func:`build_report` turns it into the budget dict that
+``report --json`` emits and ``compare`` consumes.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List, Optional, Tuple
+
+from tools.dkprof.budget import op_budget
+from tools.dkprof.chrome import parse_chrome_trace
+from tools.dkprof.xplane import parse_xplane
+
+__all__ = ["build_report", "find_trace", "load_op_events", "render_markdown"]
+
+
+def find_trace(path: str) -> str:
+    """Resolve ``path`` to a concrete trace artifact.
+
+    Files pass through.  For a directory, search it and the
+    ``plugins/profile/*/`` layout beneath it, newest first, preferring
+    ``*.xplane.pb`` over ``*.trace.json[.gz]``.  Raises ``ValueError``
+    when nothing profilable is found.
+    """
+    if os.path.isfile(path):
+        return path
+    if not os.path.isdir(path):
+        raise ValueError(f"no such trace: {path}")
+    roots = [path] + sorted(
+        glob.glob(os.path.join(path, "plugins", "profile", "*")),
+        reverse=True)
+    for root in roots:
+        for pattern in ("*.xplane.pb", "*.trace.json.gz", "*.trace.json",
+                        "*.json.gz"):
+            hits = sorted(glob.glob(os.path.join(root, pattern)))
+            if hits:
+                return hits[0]
+    raise ValueError(
+        f"no *.xplane.pb or *.trace.json[.gz] under {path} "
+        "(did the DISTKERAS_PROFILE window actually close?)")
+
+
+def _xplane_op_events(planes) -> Tuple[List[dict], str]:
+    """Pick the op timeline out of the decoded planes.
+
+    Device planes (``/device:*``) carry ops on every line; host captures
+    hide them on the ``/host:CPU`` lines named after the XLA CPU client
+    (``tf_XLATfrtCpuClient/...``).  Returns ``(events, plane_label)``.
+    """
+    best: Tuple[List[dict], str] = ([], "")
+    for plane in planes:
+        name = plane.get("name") or ""
+        if name.startswith("/device:"):
+            events = [e for line in plane["lines"] for e in line["events"]]
+        elif "host" in name.lower():
+            events = [e for line in plane["lines"]
+                      if "XLA" in (line.get("name") or "")
+                      for e in line["events"]]
+        else:
+            continue
+        if sum(int(e.get("duration_ps") or 0) for e in events) > \
+                sum(int(e.get("duration_ps") or 0) for e in best[0]):
+            best = (events, name)
+    return best
+
+
+def load_op_events(path: str) -> Tuple[List[dict], str, str]:
+    """``(op_events, format, plane_label)`` for one resolved artifact."""
+    if path.endswith(".pb"):
+        with open(path, "rb") as fh:
+            planes = parse_xplane(fh.read())
+        events, plane = _xplane_op_events(planes)
+        return events, "xplane", plane
+    return parse_chrome_trace(path), "chrome", ""
+
+
+def _load_meta(trace_path: str, meta_path: Optional[str]) -> dict:
+    """The meta sidecar: explicit ``--meta`` file, else a
+    ``dkprof_meta.json`` next to (or two levels above, at the logdir of)
+    the trace artifact."""
+    candidates = [meta_path] if meta_path else [
+        os.path.join(os.path.dirname(trace_path), "dkprof_meta.json"),
+        os.path.join(os.path.dirname(trace_path), "..", "..", "..",
+                     "dkprof_meta.json"),
+    ]
+    for cand in candidates:
+        if cand and os.path.isfile(cand):
+            with open(cand, encoding="utf-8") as fh:
+                return json.load(fh)
+    if meta_path:
+        raise ValueError(f"meta file not found: {meta_path}")
+    return {}
+
+
+def build_report(path: str, meta: Optional[dict] = None,
+                 meta_path: Optional[str] = None) -> dict:
+    """The full report dict for one trace (file or logdir)."""
+    resolved = find_trace(path)
+    sidecar = _load_meta(resolved, meta_path)
+    if meta:
+        sidecar.update(meta)
+    events, fmt, plane = load_op_events(resolved)
+    if not events:
+        raise ValueError(
+            f"{resolved}: no op events found ({fmt}); for CPU captures "
+            "use the .xplane.pb (the Chrome export has no XLA op line)")
+    report = op_budget(events, sidecar)
+    report.update({"source": os.path.abspath(resolved), "format": fmt})
+    if plane:
+        report["plane"] = plane
+    return report
+
+
+def render_markdown(report: dict) -> str:
+    """The budget as a PERF.md-style markdown table."""
+    lines = [
+        f"# dkprof report — {os.path.basename(report['source'])}",
+        "",
+        f"Total attributed op time: **{report['total_ms']:.3f} ms** "
+        f"({report['op_count']} op executions, "
+        f"{report['distinct_ops']} distinct ops"
+        + (f", plane `{report['plane']}`" if report.get("plane") else "")
+        + ")"
+        + (f" — MFU **{report['mfu']:.3f}**" if "mfu" in report else ""),
+        "",
+        "| Group | ms | % | achieved TFLOP/s | MFU | GB/s | roofline |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for g in report["groups"]:
+        lines.append(
+            f"| {g['group']} | {g['time_ms']:.3f} | {g['pct']:.1f} "
+            f"| {g.get('achieved_tflops', '—')} | {g.get('mfu', '—')} "
+            f"| {g.get('achieved_gbs', '—')} | {g.get('roofline', '—')} |")
+    lines.append("")
+    lines.append("Top ops per group:")
+    lines.append("")
+    for g in report["groups"]:
+        ops = ", ".join(
+            f"`{o['name']}` ({o['time_ms']:.3f} ms ×{o['count']})"
+            for o in g["ops"][:3])
+        lines.append(f"- **{g['group']}**: {ops}")
+    lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,137 @@
+"""Self-contained XSpace (``*.xplane.pb``) wire-format decoder.
+
+``jax.profiler.start_trace`` writes its device timeline as an XSpace
+protobuf under ``<logdir>/plugins/profile/<ts>/<host>.xplane.pb``.  The
+canonical decoder lives in tensorboard/tensorflow, which this repo must
+not depend on — so dkprof reads the wire format directly.  Only the
+fields attribution needs are decoded (plane/line names, event metadata
+names, event durations/occurrence counts); everything else is skipped by
+wire type, which is also what keeps the decoder robust to schema
+additions.
+
+Message numbers (tensorflow/tsl ``xplane.proto``):
+
+* ``XSpace``: planes = 1
+* ``XPlane``: id = 1, name = 2, lines = 3, event_metadata (map) = 4
+* ``XLine``: id = 1, name = 2, events = 4, display_name = 11
+* ``XEvent``: metadata_id = 1, offset_ps = 2, duration_ps = 3,
+  num_occurrences = 5 (aggregated op-profile lines use this)
+* ``XEventMetadata``: id = 1, name = 2, display_name = 4
+* map entries: key = 1, value = 2
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+__all__ = ["parse_xplane"]
+
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    value = 0
+    shift = 0
+    while True:
+        byte = buf[i]
+        i += 1
+        value |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return value, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long (corrupt xplane.pb?)")
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield ``(field_number, wire_type, value)`` over one message body.
+    Length-delimited values come back as ``bytes``; varints as ``int``;
+    fixed 32/64-bit values as raw ``bytes`` (unused here, kept for skip
+    correctness)."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        field, wire = tag >> 3, tag & 0x7
+        if wire == 0:
+            value, i = _varint(buf, i)
+        elif wire == 1:
+            value, i = buf[i:i + 8], i + 8
+        elif wire == 2:
+            length, i = _varint(buf, i)
+            value, i = buf[i:i + length], i + length
+        elif wire == 5:
+            value, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wire} (field {field})")
+        yield field, wire, value
+
+
+def _decode_event_metadata(buf: bytes) -> Tuple[int, str]:
+    meta_id, name, display = 0, "", ""
+    for field, _wire, value in _fields(buf):
+        if field == 1:
+            meta_id = int(value)
+        elif field == 2:
+            name = bytes(value).decode("utf-8", "replace")
+        elif field == 4:
+            display = bytes(value).decode("utf-8", "replace")
+    return meta_id, (display or name)
+
+
+def _decode_event(buf: bytes) -> dict:
+    ev = {"metadata_id": 0, "offset_ps": 0, "duration_ps": 0,
+          "num_occurrences": 1}
+    for field, _wire, value in _fields(buf):
+        if field == 1:
+            ev["metadata_id"] = int(value)
+        elif field == 2:
+            ev["offset_ps"] = int(value)
+        elif field == 3:
+            ev["duration_ps"] = int(value)
+        elif field == 5:
+            ev["num_occurrences"] = max(1, int(value))
+    return ev
+
+
+def _decode_line(buf: bytes) -> dict:
+    line = {"name": "", "events": []}
+    display = ""
+    for field, _wire, value in _fields(buf):
+        if field == 2:
+            line["name"] = bytes(value).decode("utf-8", "replace")
+        elif field == 4:
+            line["events"].append(_decode_event(bytes(value)))
+        elif field == 11:
+            display = bytes(value).decode("utf-8", "replace")
+    if display:
+        line["name"] = display
+    return line
+
+
+def _decode_plane(buf: bytes) -> dict:
+    plane = {"name": "", "lines": []}
+    metadata: Dict[int, str] = {}
+    for field, _wire, value in _fields(buf):
+        if field == 2:
+            plane["name"] = bytes(value).decode("utf-8", "replace")
+        elif field == 3:
+            plane["lines"].append(_decode_line(bytes(value)))
+        elif field == 4:
+            for mfield, _mw, mvalue in _fields(bytes(value)):
+                if mfield == 2:
+                    meta_id, name = _decode_event_metadata(bytes(mvalue))
+                    metadata[meta_id] = name
+    for line in plane["lines"]:
+        for ev in line["events"]:
+            ev["name"] = metadata.get(ev.pop("metadata_id"), "")
+    return plane
+
+
+def parse_xplane(data: bytes) -> List[dict]:
+    """Decode an XSpace blob into
+    ``[{"name": plane, "lines": [{"name", "events": [{"name",
+    "offset_ps", "duration_ps", "num_occurrences"}]}]}, ...]``."""
+    planes = []
+    for field, _wire, value in _fields(data):
+        if field == 1:
+            planes.append(_decode_plane(bytes(value)))
+    return planes

@@ -7,8 +7,26 @@ timeline: distinct ``pid``/``process_name`` metadata per input, clock-skew
 alignment of job traces into the daemon's ``job_run`` dispatch windows, and
 a run_id cross-check so traces from different fleets don't get silently
 stitched together.
+
+``python -m tools.dktrace critical-path <request_id> <path>...`` joins the
+``request_id``/``trace_id``-stamped serving spans (router attempts, replica
+HTTP hop, engine queue-wait/prefill/decode) back into one per-request
+breakdown — works on raw per-process dumps, merged timelines, and
+``/trace?request_id=`` downloads alike.
 """
 
+from tools.dktrace.critical_path import (
+    critical_path,
+    load_events,
+    render_text,
+    request_events,
+)
 from tools.dktrace.merge import merge_trace_dirs
 
-__all__ = ["merge_trace_dirs"]
+__all__ = [
+    "critical_path",
+    "load_events",
+    "merge_trace_dirs",
+    "render_text",
+    "request_events",
+]

@@ -1,4 +1,5 @@
-"""CLI: ``python -m tools.dktrace merge DIR... [-o OUT]``."""
+"""CLI: ``python -m tools.dktrace merge DIR... [-o OUT]`` and
+``python -m tools.dktrace critical-path REQUEST_ID PATH... [--json]``."""
 
 from __future__ import annotations
 
@@ -6,6 +7,7 @@ import argparse
 import json
 import sys
 
+from tools.dktrace.critical_path import critical_path, load_events, render_text
 from tools.dktrace.merge import merge_trace_dirs
 
 
@@ -23,7 +25,32 @@ def main(argv=None) -> int:
                        help="telemetry dirs holding trace_<pid>.json files")
     merge.add_argument("-o", "--output", default=None,
                        help="write merged JSON here (default: stdout)")
+    cpath = sub.add_parser(
+        "critical-path",
+        help="reconstruct one serving request's critical path "
+             "(queue wait / attempts / prefill / decode / interference)",
+    )
+    cpath.add_argument("request_id", metavar="REQUEST_ID",
+                       help="the request's idempotency key (span args stamp)")
+    cpath.add_argument("paths", nargs="+", metavar="PATH",
+                       help="trace JSON files or telemetry dirs holding "
+                            "trace_*.json (mixed processes are fine)")
+    cpath.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the breakdown as JSON instead of text")
     args = parser.parse_args(argv)
+
+    if args.cmd == "critical-path":
+        try:
+            events = load_events(args.paths)
+            breakdown = critical_path(events, args.request_id)
+        except ValueError as e:
+            print(f"dktrace: error: {e}", file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(breakdown, indent=1))
+        else:
+            print(render_text(breakdown))
+        return 0
 
     try:
         payload = merge_trace_dirs(args.dirs)

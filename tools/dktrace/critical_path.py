@@ -1,0 +1,202 @@
+"""Reconstruct one serving request's critical path from trace events.
+
+The serving stack stamps ``request_id``/``trace_id`` into every span a
+request produces (router ``tier.request``/``tier.attempt``, replica
+``serving.http_request``/``serving.admit``, engine ``serving.queue_wait``/
+``serving.prefill``/``serving.decode_step``).  Given any collection of
+Chrome trace files — per-process ``trace_<pid>.json`` dumps, a
+``dktrace merge`` output, or a ``/trace?request_id=`` download — this module
+joins those spans back into the request's story: how long it queued, which
+replicas it tried and why each attempt ended, where prefill landed, and how
+much decode/interference time it saw.
+
+Durations are trustworthy across processes (each span times itself);
+absolute timestamps are only comparable within one process unless the
+inputs came from ``dktrace merge``, so ordering here leans on span
+semantics (attempt numbers, parent links), not on cross-process ts math.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List
+
+__all__ = ["critical_path", "load_events", "render_text", "request_events"]
+
+#: engine/replica span names that execute the request itself (vs routing)
+_EXEC_SPANS = ("serving.prefill", "serving.decode_step")
+
+#: engine-global spans that stall every in-flight request while open
+_INTERFERENCE = ("serving.drain", "serving.hot_swap")
+
+
+def load_events(paths) -> List[dict]:
+    """All ``traceEvents`` from ``paths`` (each a trace JSON file or a
+    directory holding ``trace_*.json``).  Raises ``ValueError`` when a
+    path yields nothing readable."""
+    events: List[dict] = []
+    for path in paths:
+        files = (sorted(glob.glob(os.path.join(path, "trace_*.json")))
+                 if os.path.isdir(path) else [path])
+        if not files:
+            raise ValueError(f"no trace_*.json under {path}")
+        for fname in files:
+            try:
+                with open(fname, encoding="utf-8") as fh:
+                    payload = json.load(fh)
+            except (OSError, ValueError) as e:
+                raise ValueError(f"unreadable trace {fname}: {e}") from e
+            evs = payload.get("traceEvents", payload if isinstance(
+                payload, list) else [])
+            if not isinstance(evs, list):
+                raise ValueError(f"{fname}: traceEvents is not a list")
+            events.extend(e for e in evs if isinstance(e, dict))
+    return events
+
+
+def _belongs(event: dict, request_id: str) -> bool:
+    args = event.get("args") or {}
+    if args.get("request_id") == request_id:
+        return True
+    return request_id in (args.get("requests") or ())
+
+
+def request_events(events, request_id: str) -> List[dict]:
+    """The complete ("ph" == "X") spans belonging to ``request_id``,
+    including batched decode steps that carry it in ``args.requests``."""
+    return [e for e in events
+            if e.get("ph") == "X" and _belongs(e, request_id)]
+
+
+def _window(events) -> Dict[int, List[float]]:
+    """Per-pid [min_ts, max_end] envelope of the request's spans — the
+    window interference overlap is measured against (same-process
+    timestamps only; cross-process ts are not comparable unmerged)."""
+    win: Dict[int, List[float]] = {}
+    for e in events:
+        t0 = float(e.get("ts") or 0.0)
+        t1 = t0 + float(e.get("dur") or 0.0)
+        pid = int(e.get("pid") or 0)
+        lo_hi = win.setdefault(pid, [t0, t1])
+        lo_hi[0] = min(lo_hi[0], t0)
+        lo_hi[1] = max(lo_hi[1], t1)
+    return win
+
+
+def critical_path(events, request_id: str) -> dict:
+    """The request's critical-path breakdown as a JSON-safe dict.
+
+    Raises ``ValueError`` when no span carries ``request_id``.
+    """
+    mine = request_events(events, request_id)
+    if not mine:
+        raise ValueError(f"no spans carry request_id {request_id!r}")
+    by_name: Dict[str, List[dict]] = {}
+    for e in mine:
+        by_name.setdefault(e["name"], []).append(e)
+    for evs in by_name.values():
+        evs.sort(key=lambda e: float(e.get("ts") or 0.0))
+
+    trace_ids = sorted({
+        tid for e in mine
+        for tid in ([e["args"].get("trace_id")] if e.get("args") else [])
+        if tid})
+
+    def _dur(name):
+        return sum(float(e.get("dur") or 0.0) for e in by_name.get(name, []))
+
+    root = (by_name.get("tier.request")
+            or by_name.get("serving.http_request")
+            or by_name.get("serving.admit") or [None])[0]
+    total_us = (float(root.get("dur") or 0.0) if root is not None
+                else max(float(e.get("ts") or 0.0) + float(e.get("dur") or 0.0)
+                         for e in mine)
+                - min(float(e.get("ts") or 0.0) for e in mine))
+
+    attempts = [{
+        "attempt": int(e["args"].get("attempt") or 0),
+        "replica": e["args"].get("replica"),
+        "outcome": e["args"].get("outcome", ""),
+        "dur_us": float(e.get("dur") or 0.0),
+    } for e in by_name.get("tier.attempt", [])]
+    attempts.sort(key=lambda a: a["attempt"])
+
+    prefills = [{
+        "slot": e["args"].get("slot"),
+        "width": e["args"].get("width"),
+        "plen": e["args"].get("plen"),
+        "dur_us": float(e.get("dur") or 0.0),
+    } for e in by_name.get("serving.prefill", [])]
+
+    decode = by_name.get("serving.decode_step", [])
+
+    # interference: drain/hot-swap spans overlapping the request's
+    # same-process window (they carry no request ids — they stall everyone)
+    win = _window(mine)
+    interference = []
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") not in _INTERFERENCE:
+            continue
+        lo_hi = win.get(int(e.get("pid") or 0))
+        if lo_hi is None:
+            continue
+        t0 = float(e.get("ts") or 0.0)
+        t1 = t0 + float(e.get("dur") or 0.0)
+        overlap = min(t1, lo_hi[1]) - max(t0, lo_hi[0])
+        if overlap > 0:
+            interference.append(
+                {"name": e["name"], "overlap_us": round(overlap, 3)})
+
+    return {
+        "request_id": request_id,
+        "trace_ids": trace_ids,
+        "total_us": round(total_us, 3),
+        "outcome": (root or {}).get("args", {}).get("outcome", ""),
+        "queue_wait_us": round(_dur("serving.queue_wait"), 3),
+        "attempts": attempts,
+        "http_hops": len(by_name.get("serving.http_request", [])),
+        "http_us": round(_dur("serving.http_request"), 3),
+        "admit_us": round(_dur("serving.admit"), 3),
+        "prefills": prefills,
+        "decode_steps": len(decode),
+        "decode_us": round(_dur("serving.decode_step"), 3),
+        "interference": interference,
+        "span_count": len(mine),
+    }
+
+
+def _ms(us: float) -> str:
+    return f"{us / 1000.0:9.3f} ms"
+
+
+def render_text(bd: dict) -> str:
+    """Human-readable critical-path report (one request)."""
+    lines = [
+        f"request {bd['request_id']}"
+        + (f"  trace {','.join(bd['trace_ids'])}" if bd["trace_ids"] else ""),
+        f"  total        {_ms(bd['total_us'])}"
+        + (f"  outcome={bd['outcome']}" if bd["outcome"] else ""),
+        f"  queue wait   {_ms(bd['queue_wait_us'])}",
+    ]
+    for a in bd["attempts"]:
+        lines.append(
+            f"  attempt {a['attempt']} -> {a['replica']:<16s} "
+            f"{_ms(a['dur_us'])}  {a['outcome']}")
+    if bd["http_hops"]:
+        lines.append(
+            f"  http hop x{bd['http_hops']:<3d}{_ms(bd['http_us'])}")
+    for p in bd["prefills"]:
+        lines.append(
+            f"  prefill      {_ms(p['dur_us'])}  "
+            f"slot={p['slot']} width={p['width']} plen={p['plen']}")
+    if bd["decode_steps"]:
+        per = bd["decode_us"] / bd["decode_steps"]
+        lines.append(
+            f"  decode x{bd['decode_steps']:<4d}{_ms(bd['decode_us'])}  "
+            f"({per / 1000.0:.3f} ms/step)")
+    for i in bd["interference"]:
+        lines.append(f"  interference {_ms(i['overlap_us'])}  {i['name']}")
+    lines.append(f"  spans        {bd['span_count']:5d}")
+    return "\n".join(lines)
