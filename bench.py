@@ -1569,6 +1569,98 @@ def run_datapipe(n: int = 8192, feature_dim: int = 64, batch: int = 64,
     ]
 
 
+def run_checkpoint_verify(reps: int = 5) -> list:
+    """Checkpoint verification cost rows (``--checkpoint-verify``).
+
+    Prices the two verification modes the publication layer offers on a
+    headline-config-sized state (params + one optimizer copy, shapes from
+    ``LAYER_SPECS[HEADLINE]``), so the fast/full trade-off in the serving
+    watcher and restore paths is a measured number, not folklore:
+
+    * ``checkpoint_verify_fast_ms`` — existence + size stat of every
+      manifested file (what ``CheckpointWatcher.poll`` pays per new step);
+    * ``checkpoint_verify_full_ms`` — the same plus sha256 of every byte
+      (what restore/swap pays; the memo is cleared each rep so the row
+      prices a cold hash, not the cache).
+
+    Device-free apart from the orbax save; runs under ``JAX_PLATFORMS=cpu``.
+    """
+    import shutil
+    import tempfile
+
+    from distkeras_tpu import checkpoint as ckpt
+
+    rng = np.random.default_rng(0)
+
+    def arr(*shape):
+        # incompressible fill: zero arrays deflate to ~nothing on disk and
+        # the hash pass would price a toy file, not a real checkpoint
+        return rng.standard_normal(shape).astype(np.float32)
+
+    def params_like(spec):
+        out = []
+        for layer in spec:
+            kind = layer[0]
+            if kind == "conv":
+                _, _, _, cout, k_, cin, _ = layer
+                out.append(arr(k_, k_, cin, cout))
+                out.append(arr(cout))
+            elif kind == "conv1d":
+                _, length, cout, k_, cin = layer
+                out.append(arr(k_, cin, cout))
+                out.append(arr(cout))
+            elif kind == "dense":
+                _, fin, fout = layer
+                out.append(arr(fin, fout))
+                out.append(arr(fout))
+            elif kind == "embed":
+                _, vocab, dim, _ = layer
+                out.append(arr(vocab, dim))
+            elif kind == "bn":
+                _, _, _, c = layer
+                out.append(arr(2, c))
+        return out
+
+    params = params_like(LAYER_SPECS[HEADLINE])
+    state = {"params": {str(i): p for i, p in enumerate(params)},
+             "opt": {str(i): p.copy() for i, p in enumerate(params)}}
+    state_mb = sum(p.nbytes for p in params) * 2 / 1e6
+
+    d = tempfile.mkdtemp(prefix="dk_ckpt_verify_")
+    try:
+        ckpt.save_checkpoint(d, state, 1)
+        ckpt.wait_until_finished()
+        n_files = len(ckpt._step_files(os.path.join(d, "step_1")))
+
+        def timed(mode):
+            vals = []
+            for _ in range(max(1, reps)):
+                ckpt._VERIFIED.clear()  # price a cold verify, not the memo
+                t0 = time.perf_counter()
+                failure = ckpt.verify_failure(d, 1, mode)
+                vals.append((time.perf_counter() - t0) * 1e3)
+                assert failure is None, failure
+            return statistics.median(vals)
+
+        fast_ms = timed("fast")
+        full_ms = timed("full")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    proto = (f"orbax save of a {state_mb:.1f} MB headline-shaped state, "
+             f"median of {reps} cold verifies")
+    return [
+        {"metric": "checkpoint_verify_fast_ms", "value": round(fast_ms, 3),
+         "unit": "ms to stat-verify one manifested step (watcher poll cost)",
+         "vs_baseline": None, "state_mb": round(state_mb, 1),
+         "files": n_files, "protocol": proto},
+        {"metric": "checkpoint_verify_full_ms", "value": round(full_ms, 3),
+         "unit": "ms to sha256-verify one manifested step (swap/restore cost)",
+         "vs_baseline": None, "state_mb": round(state_mb, 1),
+         "files": n_files, "protocol": proto},
+    ]
+
+
 def write_baseline(results: dict) -> None:
     """Pin the current sweep as the regression baseline, stamped with the
     protocol it was measured under (``--write-baseline``)."""
@@ -1614,6 +1706,10 @@ def main():
                         help="emit host-only data-plane rows (prefetch-ring "
                         "blocks/sec + stall fraction, packing efficiency) "
                         "and exit — needs no accelerator backend")
+    parser.add_argument("--checkpoint-verify", action="store_true",
+                        help="emit checkpoint verification cost rows (fast "
+                        "stat-verify vs full sha256-verify of a headline-"
+                        "sized step) and exit — runs on CPU")
     parser.add_argument("--write-baseline", action="store_true",
                         help="pin this sweep's medians (+ protocol) as "
                         "bench_baseline.json")
@@ -1663,6 +1759,16 @@ def main():
         except Exception as e:  # noqa: BLE001 — one JSON line, always
             _emit_error(f"{type(e).__name__}: {e}",
                         metric="datapipe_blocks_per_sec")
+        return
+    if args.checkpoint_verify:
+        # CPU fast path: one orbax save, then priced stat- and hash-verify
+        # passes.  No deadman — the whole thing is seconds of host work.
+        try:
+            for row in run_checkpoint_verify():
+                print(_ok_line(row))
+        except Exception as e:  # noqa: BLE001 — one JSON line, always
+            _emit_error(f"{type(e).__name__}: {e}",
+                        metric="checkpoint_verify_full_ms")
         return
     if args.cpu:
         import jax

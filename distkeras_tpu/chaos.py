@@ -29,6 +29,18 @@ makes recovery paths provable in CI rather than asserted.
                         while requests are in flight; fires once)
 ``stall_http=K``        first K ``http`` sites (health probes) sleep
                         ``stall_secs`` — a wedged ``/healthz``
+``kill_commit=N``       raise :class:`ChaosKilled` at the Nth ``ckpt_commit``
+                        site — after the orbax write landed but *before* the
+                        manifest commit record was published (fires once)
+``delay_commit_ms=M``   every ``ckpt_commit`` site sleeps M milliseconds
+                        first — widens the committed-but-unpublished window
+                        a cross-process watcher must never surface
+``torn_ckpt=N``         truncate one seeded leaf file of the Nth *published*
+                        checkpoint (post-commit torn write / lost page
+                        cache; fires once)
+``flip_ckpt=N``         flip one seeded bit in one seeded leaf file of the
+                        Nth published checkpoint (bit rot — sizes intact,
+                        only a full digest verify can catch it; fires once)
 ======================  =====================================================
 
 Example: ``DISTKERAS_CHAOS=7:kill_block=5,refuse_connect=2``.
@@ -52,6 +64,7 @@ __all__ = [
     "counts",
     "enabled",
     "fault",
+    "corrupt_ckpt",
     "spec",
     "tear_bytes",
     "wrap_blocks",
@@ -65,6 +78,7 @@ _INT_KEYS = frozenset({
     "kill_epoch", "kill_block", "stall_block", "refuse_connect",
     "drop_reply", "drop_recv", "tear_send", "delay_send_ms",
     "kill_replica", "stall_http",
+    "kill_commit", "delay_commit_ms", "torn_ckpt", "flip_ckpt",
 })
 _FLOAT_KEYS = frozenset({"stall_secs"})
 
@@ -188,7 +202,7 @@ def _note(kind: str) -> None:
 def fault(site: str) -> None:
     """Fire any armed fault for ``site``; no-op (beyond one counter bump)
     otherwise.  Sites: ``connect``, ``send``, ``recv``, ``rpc_reply``,
-    ``epoch``, ``block``, ``replica``, ``http``."""
+    ``epoch``, ``block``, ``replica``, ``http``, ``ckpt_commit``."""
     cfg = spec()
     if cfg is None:
         return
@@ -239,6 +253,17 @@ def fault(site: str) -> None:
         if k is not None and n < k:
             _note("stall_http")
             time.sleep(cfg.get("stall_secs") or 0.05)  # dklint: disable=DK112 — injected stall
+    elif site == "ckpt_commit":
+        delay = cfg.get("delay_commit_ms")
+        if delay:
+            _note("delay_commit")
+            time.sleep(delay / 1000.0)  # dklint: disable=DK112 — injected stall
+        k = cfg.get("kill_commit")
+        if k is not None and n == k and _fire_once("kill_commit"):
+            _note("kill_commit")
+            raise ChaosKilled(
+                f"chaos: killed between orbax commit and manifest publish "
+                f"(publish {n})")
 
 
 def tear_bytes(site: str, frame_len: int) -> Optional[int]:
@@ -261,6 +286,50 @@ def tear_bytes(site: str, frame_len: int) -> Optional[int]:
     _note("tear_send")
     rng = random.Random((cfg.seed << 16) ^ n)
     return rng.randrange(1, max(2, frame_len))
+
+
+def corrupt_ckpt(paths: Iterable[str]) -> Optional[str]:
+    """Fire any armed post-publish checkpoint corruption (``torn_ckpt`` /
+    ``flip_ckpt``) against one seeded file from ``paths``; consumes one hit
+    of the ``ckpt_publish`` site per call.  Models damage that lands *after*
+    the manifest committed (torn page-cache writeback, bit rot) — which is
+    exactly what digest verification exists to catch — so the caller must
+    invoke it after its commit record is durable.  Returns a description of
+    the injected damage, ``None`` when nothing fired."""
+    cfg = spec()
+    if cfg is None:
+        return None
+    n = _next_count("ckpt_publish")
+    # only non-empty regular files can be meaningfully damaged
+    candidates = sorted(p for p in paths
+                        if os.path.isfile(p) and os.path.getsize(p) > 0)
+    if not candidates:
+        return None
+    k = cfg.get("torn_ckpt")
+    if k is not None and n == k and _fire_once("torn_ckpt"):
+        _note("torn_ckpt")
+        rng = random.Random((cfg.seed << 16) ^ (0x70 + n))
+        target = candidates[rng.randrange(len(candidates))]
+        size = os.path.getsize(target)
+        keep = rng.randrange(size)  # always a proper prefix
+        with open(target, "rb+") as fh:
+            fh.truncate(keep)
+        return f"torn {target} at {keep}/{size} bytes"
+    k = cfg.get("flip_ckpt")
+    if k is not None and n == k and _fire_once("flip_ckpt"):
+        _note("flip_ckpt")
+        rng = random.Random((cfg.seed << 16) ^ (0xF0 + n))
+        target = candidates[rng.randrange(len(candidates))]
+        size = os.path.getsize(target)
+        offset = rng.randrange(size)
+        bit = 1 << rng.randrange(8)
+        with open(target, "rb+") as fh:
+            fh.seek(offset)
+            byte = fh.read(1)[0]
+            fh.seek(offset)
+            fh.write(bytes([byte ^ bit]))
+        return f"flipped bit {bit:#04x} at {target}:{offset}"
+    return None
 
 
 def wrap_blocks(blocks: Iterable) -> Iterator:

@@ -11,17 +11,35 @@ Saves are asynchronous (``ocp.AsyncCheckpointer``): the host thread returns
 as soon as the state is snapshotted, so per-epoch checkpointing stays off
 the training path; ``CheckpointManager.wait()`` (called by trainers at the
 end of the epoch loop, and implicitly before any restore) flushes the queue.
+
+**Verified publication.**  A step is *published* — visible to restores,
+watchers, GC, and the serving tier — only once a ``step_N.manifest.json``
+commit record sits next to its directory: per-file sha256 + sizes + step +
+run id, written tmp + fsync + ``os.replace`` (+ parent-dir fsync) after the
+orbax commit landed.  :func:`verify_checkpoint` checks a published step
+against its manifest (``fast`` = existence + sizes, ``full`` = digests);
+every restore path verifies before load, renames a failing step aside
+(``step_N.corrupt`` + ``checkpoint_quarantined_total``), and falls back to
+the newest step that does verify — so a torn write or a flipped bit can
+cost at most one checkpoint interval, never the run or the serving fleet.
+Orbax directories without a manifest are *unverified* (a crash between the
+orbax write and the manifest commit, another process's in-flight save, or a
+pre-manifest checkpoint — adopt those explicitly via
+:func:`write_manifest`): never restored, never GC'd, never quarantined.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import threading
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
+from distkeras_tpu import chaos as _chaos
 from distkeras_tpu import telemetry
 
 __all__ = [
@@ -29,6 +47,8 @@ __all__ = [
     "model_state_worker_mean", "latest_step",
     "checkpoint_num_workers", "CheckpointManager", "CheckpointWatcher",
     "save_data_state", "restore_data_state",
+    "manifest_path", "write_manifest", "verify_checkpoint", "verify_failure",
+    "quarantine_step", "committed_steps",
 ]
 
 _CHECKPOINTER = None
@@ -60,11 +80,164 @@ def _pytree_checkpointer():
     return _PYTREE_CHECKPOINTER
 
 
+# ------------------------------------------------------ verified publication
+
+#: (directory, step) pairs whose orbax save has been enqueued but whose
+#: manifest has not been published yet.  In-process bookkeeping only — it
+#: mirrors exactly the window a real crash would leave on disk (orbax dir
+#: without a manifest), so losing it to a crash loses nothing.
+_PENDING: list = []
+_PENDING_LOCK = threading.Lock()
+
+#: (manifest path) -> (manifest stat, per-file stats) recorded when a step
+#: passed a FULL digest verify — skips re-hashing multi-GB state when one
+#: resume sequence (worker-count probe, center restore, model-state reduce)
+#: re-resolves the same step several times.  A memo hit still stats every
+#: file: any size/mtime change since the digests were proven (a republish,
+#: or damage landing after the verify) drops the memo and re-hashes.
+_VERIFIED: dict = {}
+
+
+def manifest_path(directory: str, step: int) -> str:
+    """The ``step_<n>.manifest.json`` commit record published after the
+    orbax save lands.  A plain file, so :func:`committed_steps`'s digit
+    parse never mistakes it for a step directory."""
+    return os.path.join(os.path.abspath(directory),
+                        f"step_{step}.manifest.json")
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a directory entry durable (the rename itself, not just the
+    renamed bytes).  Best-effort: not every filesystem lets you open or
+    fsync a directory."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    """tmp + fsync + ``os.replace`` + parent-dir fsync: a reader sees the
+    old file or the new file, never a torn one — and the new one survives
+    power loss once this returns."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh, sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def _step_files(step_dir: str) -> list:
+    """Every regular file under a step directory, as sorted relative paths
+    — the manifest's (and verify's) stable enumeration order."""
+    out = []
+    for root, dirs, files in os.walk(step_dir):
+        dirs.sort()
+        for name in sorted(files):
+            out.append(os.path.relpath(os.path.join(root, name), step_dir))
+    return out
+
+
+def _sha256_file(path: str):
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+            size += len(chunk)
+    return h.hexdigest(), size
+
+
+def write_manifest(directory: str, step: int) -> str:
+    """Hash a committed ``step_<n>`` directory and publish its commit
+    record.  Called automatically as async saves land; call it directly
+    only to *adopt* a checkpoint written by an external (pre-manifest)
+    writer into the verified set."""
+    directory = os.path.abspath(directory)
+    step_dir = os.path.join(directory, f"step_{step}")
+    files = {}
+    with telemetry.trace.span("checkpoint_publish", phase="ckpt",
+                              step=int(step)):
+        for rel in _step_files(step_dir):
+            digest, size = _sha256_file(os.path.join(step_dir, rel))
+            files[rel] = {"sha256": digest, "bytes": size}
+        from distkeras_tpu.telemetry.flightdeck import correlate
+
+        path = manifest_path(directory, step)
+        _atomic_write_json(path, {
+            "version": 1,
+            "step": int(step),
+            "run_id": correlate.run_id(),
+            "files": files,
+        })
+    return path
+
+
+def _publish(directory: str, step: int) -> None:
+    """Publish one landed save: chaos ``ckpt_commit`` site (kill/delay in
+    the committed-but-unpublished window), manifest write, then the
+    post-publish corruption site (torn/flipped bytes the manifest must
+    catch on the next verify)."""
+    if _chaos.enabled():
+        _chaos.fault("ckpt_commit")
+    write_manifest(directory, step)
+    if telemetry.enabled():
+        telemetry.metrics.counter(
+            "checkpoints_published_total",
+            help="checkpoint manifests committed (verified-publication record)",
+        ).inc()
+    if _chaos.enabled():
+        step_dir = os.path.join(directory, f"step_{step}")
+        _chaos.corrupt_ckpt(
+            os.path.join(step_dir, rel) for rel in _step_files(step_dir))
+
+
+def _publish_pending(purge_missing: bool = False) -> None:
+    """Publish manifests for every pending save whose final ``step_<n>``
+    directory exists — orbax renames the directory into place only at
+    commit, so the listing alone is commit evidence.  ``purge_missing``
+    (set after a clean flush) drops entries whose save provably failed."""
+    with _PENDING_LOCK:
+        entries = list(_PENDING)
+    for entry in entries:
+        directory, step = entry
+        if os.path.isdir(os.path.join(directory, f"step_{step}")):
+            with _PENDING_LOCK:
+                if entry not in _PENDING:
+                    continue  # another thread claimed it
+                _PENDING.remove(entry)
+            # a raise here (chaos kill_commit, ENOSPC) leaves the step
+            # unpublished for good — exactly the on-disk state a real
+            # crash in this window leaves behind
+            _publish(directory, step)
+        elif purge_missing:
+            with _PENDING_LOCK:
+                if entry in _PENDING:
+                    _PENDING.remove(entry)
+
+
 def wait_until_finished() -> None:
-    """Block until every in-flight async save has committed."""
-    if _CHECKPOINTER is not None:
-        with telemetry.trace.span("checkpoint_flush", phase="ckpt"):
-            _CHECKPOINTER.wait_until_finished()
+    """Block until every in-flight async save has committed, then publish
+    the manifests that make those commits visible."""
+    try:
+        if _CHECKPOINTER is not None:
+            with telemetry.trace.span("checkpoint_flush", phase="ckpt"):
+                _CHECKPOINTER.wait_until_finished()
+    finally:
+        # even when the flush re-raises a failed async save, the saves
+        # that DID land still publish (train_with_recovery resumes from
+        # them); only a clean flush proves a missing dir means a dead
+        # save rather than one still in flight
+        _publish_pending()
+    _publish_pending(purge_missing=True)
 
 
 def save_checkpoint(directory: str, state: Any, step: int,
@@ -79,15 +252,40 @@ def save_checkpoint(directory: str, state: Any, step: int,
     in-flight write to the same path."""
     import orbax.checkpoint as ocp
 
-    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    directory = os.path.abspath(directory)
+    path = os.path.join(directory, f"step_{step}")
+    entry = (directory, int(step))
+    if not force and os.path.isdir(path) \
+            and not os.path.exists(manifest_path(directory, step)):
+        # an orbax dir with no manifest is an orphan from a crash between
+        # the orbax commit and the manifest publish: nothing will ever
+        # restore it, so the re-save of its step overwrites it
+        force = True
     # "checkpoint_enqueue" covers only the synchronous part of an async
     # save: the host snapshot plus handing the write to Orbax's thread.
     with telemetry.trace.span("checkpoint_enqueue", phase="ckpt", step=int(step)):
         host_state = jax.tree.map(np.asarray, state)
         if force:
-            _checkpointer().wait_until_finished()
+            # the step is being superseded: retract its pending record and
+            # its published manifest FIRST, so the stale manifest can never
+            # describe (and a reader never verify against) the replacement
+            # bytes orbax is about to write
+            with _PENDING_LOCK:
+                if entry in _PENDING:
+                    _PENDING.remove(entry)
+            try:
+                os.remove(manifest_path(directory, step))
+            except FileNotFoundError:
+                pass
+            wait_until_finished()
         _checkpointer().save(
             path, args=ocp.args.StandardSave(host_state), force=force)
+    # orbax's save() waited for every *previous* save internally, so those
+    # are committed now — publish their manifests before registering this
+    # one (whose manifest lands at the next flush / save)
+    with _PENDING_LOCK:
+        _PENDING.append(entry)
+    _publish_pending()
     if telemetry.enabled():
         telemetry.metrics.counter(
             "checkpoints_saved_total", help="async checkpoint saves enqueued"
@@ -105,13 +303,12 @@ def data_state_path(directory: str, step: int) -> str:
 
 def save_data_state(directory: str, data_state, step: int) -> str:
     """Write the data checkpoint sidecar for ``step`` — synchronous (a few
-    hundred bytes) and atomic (tmp + rename), so a crash can never leave a
-    half-written cursor next to a committed model step."""
+    hundred bytes), atomic, and durable (tmp + fsync + rename + dir fsync),
+    so a crash can never leave a half-written cursor next to a committed
+    model step, and power loss cannot un-write one that was reported
+    saved."""
     path = data_state_path(directory, step)
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(data_state.to_json(), fh)
-    os.replace(tmp, path)
+    _atomic_write_json(path, data_state.to_json())
     return path
 
 
@@ -134,9 +331,30 @@ def restore_data_state(directory: str, step: Optional[int] = None):
 
 
 def committed_steps(directory: str) -> list:
-    """Steps whose final ``step_<n>`` directory exists — async saves only
-    get their final name at commit, so the listing alone is a commit
-    record (no flush needed)."""
+    """*Published* steps: a ``step_<n>.manifest.json`` commit record next
+    to a final ``step_<n>`` directory — readable cross-process with no
+    flush.  Orbax dirs without a manifest (in-flight async saves, crashes
+    between the orbax write and the manifest commit) and quarantined
+    ``step_<n>.corrupt`` renames do not count."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return []
+    names = set(os.listdir(directory))
+    suffix = ".manifest.json"
+    out = []
+    for d in names:
+        if d.startswith("step_") and d.endswith(suffix):
+            num = d[len("step_"):-len(suffix)]
+            if num.isdigit() and f"step_{num}" in names:
+                out.append(int(num))
+    return sorted(out)
+
+
+def _orbax_step_dirs(directory: str) -> list:
+    """Steps with a final orbax dir, manifested or not — the pre-manifest
+    commit evidence.  Restores never trust this alone; it exists for the
+    recovery paths that must *see* an unpublished step (to avoid colliding
+    with or deleting it) without ever loading it."""
     directory = os.path.abspath(directory)
     if not os.path.isdir(directory):
         return []
@@ -153,15 +371,166 @@ def latest_step(directory: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def verify_failure(directory: str, step: int,
+                   mode: str = "fast") -> Optional[str]:
+    """Why ``step`` fails verification against its manifest, or ``None``
+    when it passes.  ``fast`` checks every manifested file exists at its
+    recorded size (catches torn writes); ``full`` additionally re-hashes
+    every file (catches bit flips — sizes intact, digests not).
+    ``off`` always passes."""
+    if mode not in ("off", "fast", "full"):
+        raise ValueError(f"verify mode must be off|fast|full, got {mode!r}")
+    if mode == "off":
+        return None
+    directory = os.path.abspath(directory)
+    mpath = manifest_path(directory, step)
+    try:
+        with open(mpath, "r", encoding="utf-8") as fh:
+            manifest = json.load(fh)
+        files = manifest["files"]
+    except FileNotFoundError:
+        return (f"step {step} has no manifest (in-flight save, crashed "
+                "publish, or pre-manifest checkpoint)")
+    except (ValueError, KeyError, OSError) as e:
+        return f"step {step} manifest unreadable: {e}"
+    step_dir = os.path.join(directory, f"step_{step}")
+    hash_files = mode == "full"
+    if hash_files:
+        memo = _VERIFIED.get(mpath)
+        if memo is not None:
+            try:
+                st = os.stat(mpath)
+                if memo[0] == (st.st_mtime_ns, st.st_size):
+                    hash_files = False  # digests proven; stats re-checked below
+                else:
+                    _VERIFIED.pop(mpath, None)
+                    memo = None
+            except OSError:
+                memo = None
+    file_stats = []
+    for rel in sorted(files):
+        full = os.path.join(step_dir, rel)
+        want = files[rel]
+        try:
+            st = os.stat(full)
+        except OSError:
+            return f"step {step}: {rel} missing"
+        if st.st_size != int(want["bytes"]):
+            return (f"step {step}: {rel} is {st.st_size} bytes, "
+                    f"manifest says {want['bytes']}")
+        if mode == "full" and not hash_files:
+            # memo hit: the digests were proven earlier — but only for the
+            # bytes as they were THEN; any stat drift since re-hashes
+            if (rel, st.st_size, st.st_mtime_ns) not in memo[1]:
+                _VERIFIED.pop(mpath, None)
+                return verify_failure(directory, step, mode)
+        if hash_files:
+            digest, _size = _sha256_file(full)
+            if digest != want["sha256"]:
+                return f"step {step}: {rel} sha256 mismatch"
+            file_stats.append((rel, st.st_size, st.st_mtime_ns))
+    if hash_files:
+        try:
+            st = os.stat(mpath)
+            _VERIFIED[mpath] = ((st.st_mtime_ns, st.st_size),
+                                frozenset(file_stats))
+        except OSError:
+            pass
+    return None
+
+
+def verify_checkpoint(directory: str, step: int,
+                      mode: str = "fast") -> bool:
+    """Whether ``step`` passes manifest verification (see
+    :func:`verify_failure` for the mode semantics and the reason text)."""
+    return verify_failure(directory, step, mode) is None
+
+
+def quarantine_step(directory: str, step: int, reason: str = "") -> str:
+    """Move a corrupt step out of the restorable set: ``step_N`` →
+    ``step_N.corrupt`` (suffix-numbered if that name is taken), with its
+    manifest and data sidecar renamed alongside for forensics.  The digit
+    parse in :func:`committed_steps` never matches the renamed artifacts,
+    so quarantine is also un-publication.  Writer-side only — serving
+    replicas reject and keep polling instead (they don't own the dir)."""
+    directory = os.path.abspath(directory)
+    src = os.path.join(directory, f"step_{step}")
+    dst = src + ".corrupt"
+    n = 0
+    while os.path.exists(dst) or os.path.exists(dst + ".manifest.json"):
+        n += 1
+        dst = f"{src}.corrupt.{n}"
+    if os.path.isdir(src):
+        os.replace(src, dst)
+    mpath = manifest_path(directory, step)
+    _VERIFIED.pop(mpath, None)
+    try:
+        os.replace(mpath, dst + ".manifest.json")
+    except FileNotFoundError:
+        pass
+    try:
+        os.replace(data_state_path(directory, step), dst + "_data.json")
+    except FileNotFoundError:
+        pass
+    _fsync_dir(directory)
+    if telemetry.enabled():
+        telemetry.metrics.counter(
+            "checkpoint_quarantined_total",
+            help="corrupt checkpoint steps renamed aside (step_N.corrupt)",
+        ).inc()
+        # the reason lands in the trace (spans carry attrs; there is no
+        # instant-event API) so a postmortem can see WHAT failed, not
+        # just that something did
+        with telemetry.trace.span("checkpoint_quarantine", phase="ckpt",
+                                  step=int(step), reason=reason[:200]):
+            pass
+    return dst
+
+
+def _resolve_verified(directory: str, step: Optional[int],
+                      mode: str = "full") -> int:
+    """The step a restore may actually load: verify first; quarantine a
+    corrupt step and fall back to the newest one that verifies.  An
+    explicitly requested step without a manifest raises instead of
+    falling back — it may be another process's in-flight save (never
+    rename it) or a legacy checkpoint (adopt via :func:`write_manifest`)."""
+    wait_until_finished()
+    directory = os.path.abspath(directory)
+    if step is not None:
+        reason = verify_failure(directory, step, mode)
+        if reason is None:
+            return int(step)
+        if not os.path.exists(manifest_path(directory, step)):
+            raise FileNotFoundError(
+                f"cannot restore unverified step under {directory}: {reason}")
+        quarantine_step(directory, step, reason)
+    while True:
+        steps = committed_steps(directory)
+        if not steps:
+            raise FileNotFoundError(
+                f"no verified checkpoints under {directory}")
+        newest = steps[-1]
+        reason = verify_failure(directory, newest, mode)
+        if reason is None:
+            return newest
+        quarantine_step(directory, newest, reason)
+
+
 class CheckpointWatcher:
     """Newest-step watcher over a checkpoint directory — the train→serve
-    bridge.  ``poll()`` returns the newest committed step the first time it
-    is seen, ``None`` otherwise.
+    bridge.  ``poll()`` returns the newest *verified* step the first time
+    it is seen, ``None`` otherwise.
 
-    Built on :func:`committed_steps` (directory listing = commit record),
+    Built on :func:`committed_steps` (manifest listing = commit record),
     NOT :func:`latest_step`: the latter flushes *this* process's async save
     queue, which is meaningless — and wrong to wait on — when the trainer
-    writing the checkpoints is a different process.  With ``start_after``
+    writing the checkpoints is a different process.  An orbax directory
+    whose manifest has not been published yet (an in-flight async save, or
+    a crash between the orbax write and the manifest commit) is invisible
+    here by construction, and a published step must additionally pass a
+    ``fast`` size verify before it is surfaced — a corrupt newest step is
+    skipped (older new steps still surface), never returned and never
+    touched (quarantine is the writer's job).  With ``start_after``
     omitted, the watcher baselines at the newest step already on disk at
     construction, so only steps committed *afterwards* fire (a serving
     replica that just loaded step N must not be told to hot-swap to step
@@ -177,22 +546,32 @@ class CheckpointWatcher:
         self.last_step = int(start_after)
 
     def poll(self) -> Optional[int]:
-        """The newest committed step if it is newer than anything reported
+        """The newest verified step if it is newer than anything reported
         before, else ``None``.  Intermediate steps are skipped on purpose:
         a serving fleet swaps to the freshest params, not through history."""
-        steps = committed_steps(self.directory)
-        if steps and steps[-1] > self.last_step:
-            self.last_step = steps[-1]
-            return self.last_step
+        for step in reversed(committed_steps(self.directory)):
+            if step <= self.last_step:
+                return None
+            if verify_failure(self.directory, step, "fast") is None:
+                self.last_step = step
+                return step
+            # corrupt (or mid-rewrite): leave last_step alone so a later
+            # poll re-checks; fast mode is stat-only, so re-checks are cheap
         return None
 
 
-def restore_checkpoint(directory: str, step: Optional[int] = None, like: Any = None) -> Any:
+def restore_checkpoint(directory: str, step: Optional[int] = None,
+                       like: Any = None, verify: str = "full") -> Any:
     """Load training state; ``like`` (a template pytree, e.g. a freshly built
-    TrainState) restores exact structure/dtypes and device placement."""
+    TrainState) restores exact structure/dtypes and device placement.
+
+    Verifies before load (default ``full`` — a bit flip preserves sizes, so
+    only digests prove the bytes): a corrupt step is quarantined and the
+    newest verified one loads instead; ``verify="off"`` restores blind
+    (external checkpoints without manifests)."""
     import orbax.checkpoint as ocp
 
-    path = _step_path(directory, step)
+    path = _step_path(directory, step, verify)
     template = jax.tree.map(np.asarray, like) if like is not None else None
     restored = _checkpointer().restore(
         path, args=ocp.args.StandardRestore(template)
@@ -209,12 +588,19 @@ def restore_checkpoint(directory: str, step: Optional[int] = None, like: Any = N
     return restored
 
 
-def _step_path(directory: str, step: Optional[int]) -> str:
-    wait_until_finished()
-    if step is None:
-        step = latest_step(directory)
+def _step_path(directory: str, step: Optional[int],
+               verify: str = "full") -> str:
+    """Resolve the directory a restore will read — verified (quarantine +
+    newest-verified fallback, see :func:`_resolve_verified`) unless the
+    caller opted out with ``verify="off"``."""
+    if verify == "off":
+        wait_until_finished()
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
+            step = latest_step(directory)
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {directory}")
+    else:
+        step = _resolve_verified(directory, step, verify)
     return os.path.join(os.path.abspath(directory), f"step_{step}")
 
 
@@ -426,19 +812,26 @@ class CheckpointManager:
         self._gc()
 
     def _gc(self) -> None:
-        # Only COMMITTED steps (final step_ dirs on disk) are gc
+        # Only PUBLISHED steps (manifest + final step_ dir on disk) are gc
         # candidates.  Counting the in-flight newest save toward ``keep``
-        # would, at keep=1, delete the only committed checkpoint while the
+        # would, at keep=1, delete the only restorable checkpoint while the
         # new one is still writing — a crash in that window leaves zero
-        # restorable checkpoints.  The in-flight step has no final dir yet,
-        # so excluding it both protects it and defers deleting its
-        # predecessor until it lands (at most one extra step on disk).
+        # restorable checkpoints.  An in-flight (or crashed-publish) step
+        # has no manifest yet, so excluding it both protects it and defers
+        # deleting its predecessor until it lands; quarantined
+        # ``step_N.corrupt`` renames fail the digit parse entirely and are
+        # kept for forensics.  The manifest goes FIRST (un-publication),
+        # so no reader can resolve a step whose bytes are mid-deletion.
         import shutil
 
         committed = committed_steps(self.directory)
         for s in committed[: -self.keep] if self.keep else []:
             self._saved.discard(s)
             self._partial.discard(s)
+            try:
+                os.remove(manifest_path(self.directory, s))
+            except FileNotFoundError:
+                pass
             shutil.rmtree(os.path.join(self.directory, f"step_{s}"), ignore_errors=True)
             try:
                 os.remove(data_state_path(self.directory, s))
@@ -448,6 +841,18 @@ class CheckpointManager:
     def latest(self) -> Optional[int]:
         self.wait()  # flush + exact keep policy before reading the record
         return latest_step(self.directory)
+
+    def latest_verified(self, mode: str = "full") -> Optional[int]:
+        """The newest step whose bytes provably match their manifest —
+        what resume pins: corrupt steps found on the way are quarantined
+        (with their fate counted), so a crash that tore the newest
+        checkpoint costs one checkpoint interval, not the run.  ``None``
+        when nothing verifiable exists."""
+        self.wait()
+        try:
+            return _resolve_verified(self.directory, None, mode)
+        except FileNotFoundError:
+            return None
 
     def saved_worker_count(self, step: Optional[int] = None) -> int:
         return checkpoint_num_workers(self.directory, step)
@@ -463,5 +868,6 @@ class CheckpointManager:
     ):
         return model_state_worker_mean(self.directory, step, host_bytes_budget)
 
-    def restore(self, like: Any = None, step: Optional[int] = None) -> Any:
-        return restore_checkpoint(self.directory, step, like)
+    def restore(self, like: Any = None, step: Optional[int] = None,
+                verify: str = "full") -> Any:
+        return restore_checkpoint(self.directory, step, like, verify)

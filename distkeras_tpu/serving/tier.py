@@ -43,11 +43,13 @@ FleetMembership` underneath), applied to inference replicas.
   router sheds (503 + ``Retry-After``) instead of queueing unbounded.
 
 * **Rolling checkpoint hot-swap** — :meth:`ServingTier.watch_checkpoints`
-  polls the ``CheckpointManager`` directory (commit-record listing, no
-  cross-process flush) and :meth:`ServingTier.roll` swaps the fleet one
-  replica at a time: drain → param swap (shape-stable, zero recompiles,
-  zero dropped requests) → wait until the replica probes healthy again —
-  so ≥1 replica stays dispatchable throughout.
+  polls the ``CheckpointManager`` directory (manifest commit records, no
+  cross-process flush), re-verifies each candidate step's digests at swap
+  time (a corrupt one is rejected — ``serving_checkpoint_rejected_total``
+  — and the fleet keeps its params), and :meth:`ServingTier.roll` swaps
+  the fleet one replica at a time: drain → param swap (shape-stable, zero
+  recompiles, zero dropped requests) → wait until the replica probes
+  healthy again — so ≥1 replica stays dispatchable throughout.
 
 Everything is observable: ``serving_tier_*`` counters (failovers, hedges,
 sheds, hot swaps), a per-replica health gauge, and router-level SLO
@@ -135,6 +137,19 @@ class TierExhausted(TierError):
     generation (HTTP 502)."""
 
 
+def _ckpt_rejected_counter(registry=None):
+    """The swap-time verification rejection counter — shared between the
+    router's :meth:`ServingTier.watch_checkpoints` and the replica-side
+    :func:`watch_and_swap` so both publication paths count into one name."""
+    if registry is None:
+        from distkeras_tpu.telemetry.metrics import metrics as registry
+    return registry.counter(
+        "serving_checkpoint_rejected_total",
+        help="checkpoint steps that failed re-verification at swap time "
+             "(replicas kept the old params)",
+    )
+
+
 def tier_metrics(registry=None) -> dict:
     """Get-or-create the router's instruments (default: process-global
     registry).  One canonical home for names/help so the router, the
@@ -142,6 +157,7 @@ def tier_metrics(registry=None) -> dict:
     if registry is None:
         from distkeras_tpu.telemetry.metrics import metrics as registry
     return {
+        "ckpt_rejected": _ckpt_rejected_counter(registry),
         "requests": registry.counter(
             "serving_tier_routed_total",
             help="requests completed successfully through the router",
@@ -772,9 +788,14 @@ class ServingTier:
                           poll_interval: float = 0.25) -> threading.Thread:
         """Roll the fleet whenever a newer checkpoint commits in
         ``directory``.  ``loader(step) -> (model, params)`` materializes
-        the params (e.g. ``restore_center``).  Watching stops with
-        :meth:`stop`."""
-        from distkeras_tpu.checkpoint import CheckpointWatcher
+        the params (e.g. ``restore_center``).  The watcher only surfaces
+        published steps that pass a fast size check, and each surfaced
+        step is re-verified against its manifest digests *at swap time* —
+        a step whose bytes rotted between publish and swap is rejected
+        (``serving_checkpoint_rejected_total``) with the fleet untouched:
+        old params keep serving, no request is dropped.  Watching stops
+        with :meth:`stop`."""
+        from distkeras_tpu.checkpoint import CheckpointWatcher, verify_failure
 
         watcher = CheckpointWatcher(directory)
         stop = threading.Event()
@@ -783,6 +804,9 @@ class ServingTier:
             while not stop.wait(poll_interval):
                 step = watcher.poll()
                 if step is None:
+                    continue
+                if verify_failure(directory, step, "full") is not None:
+                    self._metrics["ckpt_rejected"].inc()
                     continue
                 try:
                     model, params = loader(step)
@@ -834,11 +858,13 @@ class ServingTier:
 def watch_and_swap(engine, directory: str, loader,
                    poll_interval: float = 0.25):
     """Autonomous per-replica hot-swap: poll ``directory`` for newly
-    committed checkpoints and ``engine.hot_swap`` to each — how an HTTP
+    published checkpoints and ``engine.hot_swap`` to each — how an HTTP
     replica's serve script tracks the trainer without router involvement
-    (the router only gates health around the swap's drain).  Returns a
-    zero-arg stopper."""
-    from distkeras_tpu.checkpoint import CheckpointWatcher
+    (the router only gates health around the swap's drain).  Each step is
+    re-verified against its manifest digests right before the swap; a
+    failing one is rejected (``serving_checkpoint_rejected_total``) and
+    the engine keeps its current params.  Returns a zero-arg stopper."""
+    from distkeras_tpu.checkpoint import CheckpointWatcher, verify_failure
 
     watcher = CheckpointWatcher(directory)
     stop = threading.Event()
@@ -847,6 +873,9 @@ def watch_and_swap(engine, directory: str, loader,
         while not stop.wait(poll_interval):
             step = watcher.poll()
             if step is None:
+                continue
+            if verify_failure(directory, step, "full") is not None:
+                _ckpt_rejected_counter().inc()
                 continue
             try:
                 model, params = loader(step)

@@ -208,8 +208,12 @@ def blackbox_dump(reason: str, directory=None, extra=None) -> Optional[str]:
     d = directory or _runtime.out_dir()
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, f"blackbox_{rid}_{pid}.json")
-    with open(path, "w", encoding="utf-8") as fh:
+    # tmp + replace: post-mortem tooling globs blackbox_*.json from another
+    # process; the crashing dump must appear complete or not at all
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=1, default=repr)
+    os.replace(tmp, path)
     _registry.counter(
         "telemetry_blackbox_dumps_total",
         help="flight-recorder blackbox files written on crash boundaries",

@@ -174,12 +174,15 @@ def _wants_request(fn: Callable) -> bool:
 def _write_discovery_file() -> None:
     # Advisory: lets other processes (the daemon's status verb) find this
     # process's ephemeral port.  The exporter itself is already serving, so
-    # an unwritable telemetry dir must not take it down.
+    # an unwritable telemetry dir must not take it down.  tmp + replace:
+    # the daemon polls this file from another process, and a bare in-place
+    # dump would let it read half-written JSON.
     try:
         d = _runtime.out_dir()
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"flightdeck_{os.getpid()}.json")
-        with open(path, "w", encoding="utf-8") as fh:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
             json.dump(
                 {
                     "address": address(),
@@ -188,6 +191,9 @@ def _write_discovery_file() -> None:
                 },
                 fh,
             )
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
     except OSError:
         pass
 

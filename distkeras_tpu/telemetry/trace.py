@@ -254,8 +254,12 @@ class Tracer:
 
     def write(self, path) -> str:
         payload = self.export()
-        with open(path, "w") as fh:
+        # tmp + replace: dktrace merge / flightdeck may read this file from
+        # another process while a dump is still streaming out
+        tmp = os.fspath(path) + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(payload, fh, indent=1)
+        os.replace(tmp, path)
         return path
 
 

@@ -320,7 +320,9 @@ class Trainer:
         continues from the restored center/workers — the same
         :meth:`_restore_state` path a crash-resume takes."""
         reason = watchdog.pending_rollback
-        step = ckpt.latest() if ckpt is not None else None
+        # verified: rolling back onto a corrupt checkpoint would trade a
+        # diverged run for a crashed one
+        step = ckpt.latest_verified() if ckpt is not None else None
         if step is None:
             raise telemetry.dynamics.TrainingDiverged(
                 f"{reason} — rollback requested but no checkpoint has been "
@@ -542,8 +544,11 @@ class Trainer:
             ckpt = CheckpointManager(self.checkpoint_dir, every=self.checkpoint_every)
             # resolve the resume step ONCE; every read below pins it, so a
             # concurrent writer (second elastic job, in-flight async save)
-            # cannot hand different reads different checkpoints
-            resume_step = ckpt.latest() if self.resume else None
+            # cannot hand different reads different checkpoints.  Verified
+            # resolution: a step whose bytes no longer match its manifest
+            # (torn write, bit rot) is quarantined here and resume falls to
+            # the newest step that proves out — never loaded, never trusted
+            resume_step = ckpt.latest_verified() if self.resume else None
             resuming = resume_step is not None
             elastic = resuming and ckpt.saved_worker_count(resume_step) != engine.num_workers
             if elastic and rule.communication_window <= 0:

@@ -1,0 +1,70 @@
+"""DK118 fixture: non-atomic publication of cross-process-read files.
+
+Basename contains "checkpoint" so the whole module is in scope.
+"""
+
+import json
+import os
+import pickle
+
+
+def bad_json_dump(path, obj):
+    with open(path, "w", encoding="utf-8") as fh:  # FIRES: json.dump, no replace
+        json.dump(obj, fh)
+
+
+def bad_plain_write(path, text):
+    fh = open(path, "w")  # FIRES: .write, no replace
+    fh.write(text)
+    fh.close()
+
+
+def bad_binary_pickle(path, obj):
+    with open(path, "wb") as fh:  # FIRES: pickle.dump, no replace
+        pickle.dump(obj, fh)
+
+
+def bad_inline_write(path, text):
+    open(path, "w").write(text)  # FIRES: unbound handle written in place
+
+
+def good_tmp_then_replace(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:  # ok: os.replace commits below
+        json.dump(obj, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def good_rename_commit(path, text):
+    tmp = path + ".tmp"
+    fh = open(tmp, "w")  # ok: os.rename commits below
+    fh.write(text)
+    fh.close()
+    os.rename(tmp, path)
+
+
+def good_read_mode(path):
+    with open(path) as fh:  # ok: default mode is read
+        return fh.read()
+
+
+def good_append_log(path, line):
+    with open(path, "a") as fh:  # ok: appends are logs, not publications
+        fh.write(line)
+
+
+def good_opened_never_written(path):
+    with open(path, "w"):  # ok: truncate-only sentinel, nothing written
+        pass
+
+
+def good_nonliteral_mode(path, mode, text):
+    with open(path, mode) as fh:  # ok: mode unknown, stay silent
+        fh.write(text)
+
+
+def suppressed_write(path, obj):
+    with open(path, "w") as fh:  # dklint: disable=DK118 — single-reader scratch
+        json.dump(obj, fh)

@@ -120,7 +120,8 @@ def test_chaos_lowering_byte_identical():
     add ZERO traced ops — the lowered program is byte-identical."""
     off = _lowered_epoch_text()
     chaos.configure("7:kill_epoch=99,refuse_connect=3,tear_send=1,"
-                    "delay_send_ms=1")
+                    "delay_send_ms=1,kill_commit=9,delay_commit_ms=1,"
+                    "torn_ckpt=9,flip_ckpt=9")
     armed = _lowered_epoch_text()
     assert off == armed
 

@@ -28,7 +28,8 @@ def test_manager_gc(tmp_path):
     import os
 
     found = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
-    assert found == ["step_4", "step_5"]
+    assert found == ["step_4", "step_4.manifest.json",
+                     "step_5", "step_5.manifest.json"]
 
 
 def test_trainer_resume_matches_uninterrupted(toy_classification, tmp_path):
@@ -94,22 +95,33 @@ def test_pipeline_resume_matches_uninterrupted(tmp_path):
 
 def test_gc_never_deletes_the_only_committed_step(tmp_path):
     """keep=1 with an async save in flight: the in-flight step must not
-    count toward `keep`, or _gc deletes the only committed checkpoint and
+    count toward `keep`, or _gc deletes the only published checkpoint and
     a crash during the in-flight save leaves nothing restorable."""
     import os
+
+    from distkeras_tpu.checkpoint import write_manifest
 
     mgr = CheckpointManager(str(tmp_path), every=1, keep=1)
     state = {"x": np.zeros(2)}
     mgr.maybe_save(state, 0)
-    mgr.wait()  # step_1 committed
-    assert sorted(d for d in os.listdir(tmp_path) if d.startswith("step_")) == ["step_1"]
+    mgr.wait()  # step_1 committed + published
+    assert sorted(d for d in os.listdir(tmp_path) if d.startswith("step_")) \
+        == ["step_1", "step_1.manifest.json"]
     # simulate step 2 in flight: initiated (in _saved) but no final dir yet
     mgr._saved.add(2)
     mgr._gc()
-    assert sorted(d for d in os.listdir(tmp_path) if d.startswith("step_")) == ["step_1"], (
-        "in-flight step must not evict the only committed checkpoint"
+    assert "step_1" in os.listdir(tmp_path), (
+        "in-flight step must not evict the only published checkpoint"
     )
-    # once step 2 commits (final dir lands), the predecessor is collectable
+    # step 2's orbax dir landing is NOT enough: unpublished steps are
+    # invisible to the keep policy (and must never be deleted themselves)
     os.makedirs(tmp_path / "step_2")
     mgr._gc()
-    assert sorted(d for d in os.listdir(tmp_path) if d.startswith("step_")) == ["step_2"]
+    assert "step_1" in os.listdir(tmp_path), (
+        "an unpublished (manifest-less) step must not evict its predecessor"
+    )
+    # once step 2 PUBLISHES (manifest commits), the predecessor is collectable
+    write_manifest(str(tmp_path), 2)
+    mgr._gc()
+    assert sorted(d for d in os.listdir(tmp_path) if d.startswith("step_")) \
+        == ["step_2", "step_2.manifest.json"]

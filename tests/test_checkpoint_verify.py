@@ -1,0 +1,306 @@
+"""Verified-checkpoint publication tests (PR 15).
+
+End-to-end pins for the integrity layer: seeded chaos corruption is
+quarantined and never loaded, an in-flight save is invisible to watchers,
+a kill between orbax commit and manifest publish leaves the step
+unpublished (and adoptable), the serving swap path rejects steps that rot
+after publication, and a trainer whose newest checkpoint is quarantined
+resumes from the last verified one bit-for-bit."""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu import chaos
+from distkeras_tpu import checkpoint as ckpt
+from distkeras_tpu.frame import from_numpy
+from distkeras_tpu.models import MLP, FlaxModel
+
+
+@pytest.fixture(autouse=True)
+def chaos_off():
+    """Each test arms its own spec; leave the process env-driven."""
+    chaos.configure("")
+    yield
+    chaos.configure(None)
+
+
+def _save(d, value, step):
+    state = {"w": np.full((32,), float(value), np.float32)}
+    ckpt.save_checkpoint(str(d), state, step)
+    ckpt.wait_until_finished()
+    return state
+
+
+def _listing(d):
+    return sorted(e for e in os.listdir(d) if e.startswith("step_"))
+
+
+# ----------------------------------------------------- corruption + fallback
+
+def test_torn_corruption_is_quarantined_with_fallback(tmp_path):
+    """torn_ckpt truncates a published file: fast verify catches the size
+    drift, restore quarantines the step and falls back to the previous
+    verified one — the corrupt bytes are never loaded."""
+    _save(tmp_path, 1.0, 1)
+    chaos.configure("5:torn_ckpt=0")  # fire on the next publish
+    _save(tmp_path, 2.0, 2)
+
+    assert ckpt.committed_steps(str(tmp_path)) == [1, 2]
+    assert ckpt.verify_failure(str(tmp_path), 2, "fast") is not None
+
+    like = {"w": np.zeros((32,), np.float32)}
+    restored = ckpt.restore_checkpoint(str(tmp_path), like=like)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((32,), 1.0, np.float32))
+    # the torn step is renamed out of the committed namespace
+    assert ckpt.committed_steps(str(tmp_path)) == [1]
+    names = _listing(tmp_path)
+    assert "step_2.corrupt" in names and "step_2" not in names
+
+
+def test_flip_corruption_passes_fast_but_fails_full(tmp_path):
+    """flip_ckpt preserves the file size, so stat-level verification is
+    blind to it — only the sha256 pass (the restore/swap default) catches
+    the rot and quarantines the step."""
+    _save(tmp_path, 1.0, 1)
+    chaos.configure("5:flip_ckpt=0")
+    _save(tmp_path, 2.0, 2)
+
+    assert ckpt.verify_failure(str(tmp_path), 2, "fast") is None
+    assert ckpt.verify_failure(str(tmp_path), 2, "full") is not None
+
+    like = {"w": np.zeros((32,), np.float32)}
+    restored = ckpt.restore_checkpoint(str(tmp_path), like=like)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((32,), 1.0, np.float32))
+    assert ckpt.committed_steps(str(tmp_path)) == [1]
+    assert "step_2.corrupt" in _listing(tmp_path)
+
+
+def test_explicit_step_restore_semantics(tmp_path):
+    """Explicitly requesting a *published* step that fails verification
+    quarantines it and falls back to the newest verified one (resume
+    semantics); requesting an *unmanifested* step raises — it may be
+    another process's in-flight save, which must never be renamed."""
+    _save(tmp_path, 1.0, 1)
+    chaos.configure("5:torn_ckpt=0")
+    _save(tmp_path, 2.0, 2)
+
+    like = {"w": np.zeros((32,), np.float32)}
+    restored = ckpt.restore_checkpoint(str(tmp_path), step=2, like=like)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((32,), 1.0, np.float32))
+    assert "step_2.corrupt" in _listing(tmp_path)
+
+    # a bare orbax dir with no commit record: hands off
+    os.makedirs(tmp_path / "step_9")
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore_checkpoint(str(tmp_path), step=9, like=like)
+    assert "step_9" in _listing(tmp_path)  # never renamed, never deleted
+
+
+# ------------------------------------------------------- commit/publish gap
+
+def test_kill_between_commit_and_publish_leaves_step_unpublished(tmp_path):
+    """kill_commit dies after orbax's atomic rename but before the manifest
+    lands — exactly a crash in the publication window.  The step must stay
+    invisible (not committed, not restorable, not quarantined: the bytes
+    may be fine, there is just no commit record), and a later re-save must
+    adopt the orphan dir instead of tripping over it."""
+    _save(tmp_path, 1.0, 1)
+    chaos.configure("5:kill_commit=0")
+    state2 = {"w": np.full((32,), 2.0, np.float32)}
+    with pytest.raises(chaos.ChaosKilled):
+        ckpt.save_checkpoint(str(tmp_path), state2, 2)
+        ckpt.wait_until_finished()
+
+    # orbax committed the dir, but there is no manifest: unpublished
+    assert os.path.isdir(tmp_path / "step_2")
+    assert not os.path.exists(ckpt.manifest_path(str(tmp_path), 2))
+    assert ckpt.committed_steps(str(tmp_path)) == [1]
+    assert ckpt.latest_step(str(tmp_path)) == 1
+
+    like = {"w": np.zeros((32,), np.float32)}
+    restored = ckpt.restore_checkpoint(str(tmp_path), like=like)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((32,), 1.0, np.float32))
+
+    # recovery re-saves step 2: the orphan dir is adopted, not a crash
+    ckpt.save_checkpoint(str(tmp_path), state2, 2)
+    ckpt.wait_until_finished()
+    assert ckpt.committed_steps(str(tmp_path)) == [1, 2]
+    restored = ckpt.restore_checkpoint(str(tmp_path), like=like)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((32,), 2.0, np.float32))
+
+
+def test_watcher_never_surfaces_inflight_save(tmp_path):
+    """A watcher polling *during* a save must see nothing: the orbax dir
+    may already exist, but until the manifest commits the step is not
+    published.  delay_commit_ms holds the publication window open wide
+    enough for the main thread to poll through it."""
+    watcher = ckpt.CheckpointWatcher(str(tmp_path))
+    chaos.configure("5:delay_commit_ms=600")
+
+    done = threading.Event()
+
+    def background_save():
+        _save(tmp_path, 1.0, 1)
+        done.set()
+
+    thread = threading.Thread(target=background_save, daemon=True)
+    thread.start()
+    step_dir = tmp_path / "step_1"
+    mpath = ckpt.manifest_path(str(tmp_path), 1)
+    saw_window = False
+    surfaced = None
+    try:
+        while not done.is_set() and surfaced is None:
+            in_window = step_dir.is_dir() and not os.path.exists(mpath)
+            step = watcher.poll()
+            # re-check: if the window held across the poll, the orbax dir
+            # was committed but unpublished — poll must have seen nothing
+            if in_window and step_dir.is_dir() and not os.path.exists(mpath):
+                assert step is None, (
+                    "watcher surfaced a step before its manifest committed")
+                saw_window = True
+            elif step is not None:
+                # a surfaced step must be published: manifest on disk
+                assert os.path.exists(mpath)
+                surfaced = step
+            time.sleep(0.005)
+    finally:
+        thread.join(timeout=60)
+    # delay_commit_ms held the committed-but-unpublished window open long
+    # enough that the poll loop provably sampled inside it
+    assert saw_window
+    if surfaced is None:
+        surfaced = watcher.poll()
+    assert surfaced == 1
+    assert watcher.poll() is None  # reported once
+
+
+# ------------------------------------------------------------------ serving
+
+def test_watch_and_swap_rejects_rotted_step_and_keeps_params(tmp_path):
+    """Swap-time re-verification: a step that passes the watcher's fast
+    check but fails the full sha256 pass is rejected — the loader is never
+    called, the engine keeps its params, the rejection counter ticks —
+    and the tier recovers on the next good publication."""
+    from distkeras_tpu.serving.tier import watch_and_swap
+    from distkeras_tpu.telemetry.metrics import metrics as registry
+
+    def rejected():
+        entry = registry.snapshot().get("serving_checkpoint_rejected_total")
+        return 0.0 if entry is None else float(entry.get("value") or 0.0)
+
+    def publish(step, payload):
+        d = tmp_path / f"step_{step}"
+        d.mkdir()
+        (d / "data.bin").write_bytes(payload)
+        ckpt.write_manifest(str(tmp_path), step)
+
+    publish(10, b"baseline" * 8)  # pre-existing: baselined at construction
+
+    loaded, swapped = [], []
+
+    class Engine:
+        def hot_swap(self, model, params):
+            swapped.append(params)
+
+    def loader(step):
+        loaded.append(step)
+        return None, step
+
+    base = rejected()
+    stopper = watch_and_swap(Engine(), str(tmp_path), loader,
+                             poll_interval=0.02)
+    try:
+        # publish step 12, then rot it in place: same size, flipped byte —
+        # fast (watcher) passes, full (swap gate) fails
+        publish(12, b"x" * 64)
+        raw = bytearray((tmp_path / "step_12" / "data.bin").read_bytes())
+        raw[7] ^= 0x10
+        (tmp_path / "step_12" / "data.bin").write_bytes(raw)
+
+        deadline = time.monotonic() + 30
+        while rejected() < base + 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rejected() >= base + 1
+        assert loaded == [] and swapped == []
+
+        publish(14, b"good" * 16)  # the tier recovers on the next good step
+        deadline = time.monotonic() + 30
+        while not swapped and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        stopper()
+    assert loaded == [14] and swapped == [14]
+
+
+# ----------------------------------------------------------------------- gc
+
+def test_gc_never_deletes_quarantined_steps(tmp_path):
+    """Quarantined dirs are evidence, not garbage: the keep policy ranges
+    over published steps only and must leave ``*.corrupt`` alone."""
+    mgr = ckpt.CheckpointManager(str(tmp_path), every=1, keep=1)
+    state = {"x": np.zeros(2)}
+    for epoch in range(3):
+        mgr.maybe_save(state, epoch)
+    mgr.wait()
+    assert ckpt.committed_steps(str(tmp_path)) == [3]  # keep=1 collected 1,2
+    ckpt.quarantine_step(str(tmp_path), 3, reason="test")
+    mgr.maybe_save(state, 3)
+    mgr.wait()
+    mgr._gc()
+    names = _listing(tmp_path)
+    assert "step_3.corrupt" in names
+    assert ckpt.committed_steps(str(tmp_path)) == [4]
+
+
+# ------------------------------------------------------------------ trainer
+
+def test_resume_with_quarantined_newest_step_is_bit_exact(
+        toy_classification, tmp_path):
+    """The headline recovery story: the newest checkpoint rots on disk, the
+    resuming trainer quarantines it and restarts from the last verified
+    step, and the final params match an uninterrupted run."""
+    x, y, onehot = toy_classification
+    df = from_numpy(x, onehot)
+
+    def trainer(num_epoch, resume=False):
+        return dk.DOWNPOUR(FlaxModel(MLP(features=(16,), num_classes=2)),
+                           loss="categorical_crossentropy",
+                           worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                           num_workers=4, batch_size=16, num_epoch=num_epoch,
+                           communication_window=4, seed=11,
+                           checkpoint_dir=str(tmp_path), checkpoint_every=1,
+                           resume=resume)
+
+    straight = dk.DOWNPOUR(FlaxModel(MLP(features=(16,), num_classes=2)),
+                           loss="categorical_crossentropy",
+                           worker_optimizer=("sgd", {"learning_rate": 0.05}),
+                           num_workers=4, batch_size=16, num_epoch=4,
+                           communication_window=4, seed=11).train(df)
+
+    trainer(2).train(df)  # writes checkpoints at epochs 1,2
+    # rot the newest step in place: truncate its largest payload file
+    step_dir = str(tmp_path / "step_2")
+    files = [os.path.join(step_dir, rel) for rel in ckpt._step_files(step_dir)]
+    victim = max(files, key=os.path.getsize)
+    with open(victim, "rb+") as fh:
+        fh.truncate(os.path.getsize(victim) // 2)
+
+    resumed = trainer(4, resume=True).train(df)  # must fall back to step 1
+
+    assert "step_2.corrupt" in _listing(tmp_path)
+    for a, b in zip(jax.tree.leaves(straight.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)

@@ -72,7 +72,8 @@ def test_chunked_checkpoint_cadence_matches_per_epoch(df, tmp_path):
 
     def saved_steps(d):
         return sorted(
-            int(p.split("_", 1)[1]) for p in os.listdir(d) if p.startswith("step_")
+            int(p.split("_", 1)[1]) for p in os.listdir(d)
+            if p.startswith("step_") and p.split("_", 1)[1].isdigit()
         )
 
     d1, d4 = str(tmp_path / "per_epoch"), str(tmp_path / "chunked")

@@ -476,6 +476,40 @@ def test_dk117_out_of_package_is_silent():
     assert got == []
 
 
+def test_dk118_atomic_publish_fixture():
+    got, _ = _run("dk118_checkpoint_pub.py", ["DK118"])
+    assert got == [
+        ("DK118", 12),  # json.dump into a bare open(path, "w")
+        ("DK118", 17),  # fh = open(...); fh.write(...) with no replace
+        ("DK118", 23),  # pickle.dump into open(path, "wb")
+        ("DK118", 28),  # open(path, "w").write(...) inline
+    ]
+
+
+def test_dk118_clean_idioms_are_silent():
+    """tmp + os.replace / os.rename, read mode, append logs, never-written
+    handles, non-literal modes, and the suppression comment all stay
+    silent — only in-place publication fires."""
+    got, _ = _run("dk118_checkpoint_pub.py", ["DK118"])
+    lines = [ln for _, ln in got]
+    assert all(ln < 31 for ln in lines), lines
+
+
+def test_dk118_out_of_scope_module_is_silent(tmp_path):
+    """The same bare write outside checkpoint/telemetry/discovery scope is
+    fine — private scratch files may be written in place."""
+    src = (
+        "import json\n"
+        "def f(path, obj):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        json.dump(obj, fh)\n"
+    )
+    mod = tmp_path / "batch_tool.py"
+    mod.write_text(src)
+    findings, _ = analyze([str(mod)], root=str(tmp_path), select=["DK118"])
+    assert findings == []
+
+
 def test_dk115_out_of_scope_module_is_silent(tmp_path):
     """Same code outside the daemon/server scope stays unflagged — batch
     code may legitimately block forever."""
@@ -603,7 +637,7 @@ def test_all_rules_registered():
     assert sorted(all_rules()) == [
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
         "DK108", "DK109", "DK110", "DK111", "DK112", "DK113", "DK114",
-        "DK115", "DK116", "DK117",
+        "DK115", "DK116", "DK117", "DK118",
     ]
 
 

@@ -118,6 +118,7 @@ def test_tier_metrics_schema_golden():
     m["hot_swaps"].inc(2)
     m["roll_failures"].inc(1)
     m["deadline_expired"].inc(1)
+    m["ckpt_rejected"].inc(1)
     m["replicas_healthy"].set(3)
     m["latency"].observe(0.25)
     m["attempts"].observe(1)
@@ -293,8 +294,17 @@ def test_rolling_hot_swap_drops_nothing(lm, make_tier):
         assert post.tokens == refs_new[i]
 
 
+def _publish_step(tmp_path, step):
+    """A committed AND published step: orbax-style final dir plus the
+    manifest commit record the verified watcher requires."""
+    from distkeras_tpu.checkpoint import write_manifest
+
+    (tmp_path / f"step_{step}").mkdir()
+    write_manifest(str(tmp_path), step)
+
+
 def test_watch_and_swap_follows_committed_checkpoints(lm, tmp_path):
-    """The replica-side watcher: a newly *committed* step in the
+    """The replica-side watcher: a newly *published* step in the
     checkpoint directory hot-swaps the engine's params in place."""
     module, params = lm
     params2 = module.init(jax.random.PRNGKey(9),
@@ -304,7 +314,7 @@ def test_watch_and_swap_follows_committed_checkpoints(lm, tmp_path):
                            registry=registry)
     prompt = [1, 2, 3, 4]
     ref_new = _ref(module, params2, prompt, 4)
-    (tmp_path / "step_10").mkdir()  # pre-existing: must NOT trigger a swap
+    _publish_step(tmp_path, 10)  # pre-existing: must NOT trigger a swap
 
     loaded = []
 
@@ -317,7 +327,7 @@ def test_watch_and_swap_follows_committed_checkpoints(lm, tmp_path):
     try:
         time.sleep(0.1)
         assert loaded == []  # baselined at construction
-        (tmp_path / "step_12").mkdir()  # a fresh commit
+        _publish_step(tmp_path, 12)  # a fresh publication
         deadline = time.monotonic() + 30
         while (_ctr(registry, "serving_hot_swaps_total") < 1
                and time.monotonic() < deadline):
@@ -331,15 +341,19 @@ def test_watch_and_swap_follows_committed_checkpoints(lm, tmp_path):
 
 
 def test_checkpoint_watcher_reports_newest_once(tmp_path):
-    (tmp_path / "step_3").mkdir()
+    _publish_step(tmp_path, 3)
     watcher = CheckpointWatcher(str(tmp_path))
     assert watcher.poll() is None  # baselined at the pre-existing step
-    (tmp_path / "step_7").mkdir()
+    _publish_step(tmp_path, 7)
     assert watcher.poll() == 7
     assert watcher.poll() is None  # reported once
-    (tmp_path / "step_5").mkdir()  # older than anything reported
+    _publish_step(tmp_path, 5)  # older than anything reported
     assert watcher.poll() is None
     assert CheckpointWatcher(str(tmp_path), start_after=-1).poll() == 7
+    # a bare orbax dir with no manifest (in-flight save, crashed publish)
+    # is invisible: never surfaced, however new it is
+    (tmp_path / "step_9").mkdir()
+    assert watcher.poll() is None
 
 
 # --------------------------------------- deadline / shedding / attempt cap

@@ -1,6 +1,7 @@
 """Importing this package registers every shipped checker."""
 
 from tools.dklint.checkers import (  # noqa: F401 — registration side effects
+    atomic_publish,
     blocking,
     cardinality,
     collectives,

@@ -1,0 +1,190 @@
+"""DK118 — non-atomic publication of a cross-process-read file.
+
+The checkpoint/telemetry/discovery directories are read by *other
+processes* (serving watchers verify manifests, the daemon polls discovery
+files, dktrace merges trace dumps).  A bare ``open(path, "w")`` +
+``json.dump``/``fh.write`` publishes through a window where the file
+exists half-written: a reader polling at the wrong moment parses torn
+JSON, or worse, acts on it.  The PR-15 publication discipline is tmp +
+``os.replace`` (readers see the old file or the new file, never a torn
+one); this rule is its static twin.
+
+A finding fires on an ``open`` call when, within one function:
+
+* the file opens in a write mode (``"w"``/``"wt"``/``"wb"`` — appends are
+  logs, not publications, and stay silent);
+* the handle provably receives content — ``handle.write(...)`` /
+  ``.writelines(...)``, or the handle is an argument to a ``*.dump``
+  call (``json.dump``, ``pickle.dump``);
+* and the function contains **no** ``os.replace`` / ``os.rename`` — the
+  atomic-commit step that would make the tmp-file idiom whole.
+
+Scope: the publication surfaces only — ``checkpoint.py``, ``fleet.py``,
+``job_deployment.py``, anything under ``telemetry/``, and any module
+whose basename mentions checkpoint/flightdeck/discovery.  Private
+scratch files elsewhere may legitimately be written in place.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Tuple
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project, call_name
+from tools.dklint.registry import register
+
+_SCOPE_BASENAMES = frozenset({"checkpoint.py", "fleet.py", "job_deployment.py"})
+_SCOPE_MARKERS = ("checkpoint", "flightdeck", "discovery")
+
+_WRITE_MODES = frozenset({"w", "wt", "wb", "w+", "wb+", "w+b"})
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _in_scope(fi: FileInfo) -> bool:
+    base = os.path.basename(fi.relpath)
+    parts = fi.relpath.replace(os.sep, "/").split("/")
+    return (
+        base in _SCOPE_BASENAMES
+        or "telemetry" in parts
+        or any(m in base for m in _SCOPE_MARKERS)
+    )
+
+
+def _resolved(fi: FileInfo, node: ast.Call) -> str:
+    name = call_name(node) or ""
+    head, _, rest = name.partition(".")
+    target = fi.imports.get(head)
+    if target:
+        return target + ("." + rest if rest else "")
+    return name
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string when this ``open(...)`` opens for write."""
+    mode: Optional[ast.expr] = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return None  # default "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        if mode.value in _WRITE_MODES:
+            return mode.value
+        return None
+    return None  # non-literal mode — provenance unknown, stay silent
+
+
+def _open_bindings(fn: ast.AST) -> List[Tuple[ast.Call, Optional[str]]]:
+    """Write-mode ``open`` calls in ``fn`` with the name, if any, their
+    handle binds to (``with open(...) as fh`` / ``fh = open(...)``)."""
+    out: List[Tuple[ast.Call, Optional[str]]] = []
+
+    def bind_name(target) -> Optional[str]:
+        return target.id if isinstance(target, ast.Name) else None
+
+    seen = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                call = item.context_expr
+                if isinstance(call, ast.Call) and call_name(call) == "open" \
+                        and _write_mode(call):
+                    name = None
+                    if item.optional_vars is not None:
+                        name = bind_name(item.optional_vars)
+                    out.append((call, name))
+                    seen.add(id(call))
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if call_name(call) == "open" and _write_mode(call) \
+                    and id(call) not in seen:
+                name = bind_name(node.targets[0]) if len(node.targets) == 1 \
+                    else None
+                out.append((call, name))
+                seen.add(id(call))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and call_name(node) == "open" \
+                and _write_mode(node) and id(node) not in seen:
+            out.append((node, None))  # unbound (e.g. open(...).write(...))
+    return out
+
+
+def _handle_written(fn: ast.AST, handle: Optional[str],
+                    open_call: ast.Call) -> bool:
+    """Does the opened handle provably receive content?"""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in (
+                "write", "writelines"):
+            recv = func.value
+            if handle is not None and isinstance(recv, ast.Name) \
+                    and recv.id == handle:
+                return True
+            if recv is open_call:  # open(...).write(...)
+                return True
+        if isinstance(func, ast.Attribute) and func.attr == "dump":
+            # json.dump(obj, fh) / pickle.dump(obj, fh)
+            for arg in node.args:
+                if handle is not None and isinstance(arg, ast.Name) \
+                        and arg.id == handle:
+                    return True
+                if arg is open_call:
+                    return True
+    return False
+
+
+def _has_atomic_commit(fi: FileInfo, fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and _resolved(fi, node) in (
+                "os.replace", "os.rename"):
+            return True
+    return False
+
+
+@register
+class AtomicPublishChecker(Checker):
+    rule = "DK118"
+    name = "non-atomic-publication"
+    description = (
+        "open(path, 'w') + dump/write to a cross-process-read file "
+        "(checkpoint/telemetry/discovery) with no os.replace in the same "
+        "function — readers can see the file half-written"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        if not _in_scope(fi):
+            return
+        for fn in ast.walk(fi.tree):
+            if not isinstance(fn, _FN_NODES):
+                continue
+            nested = set()
+            for child in ast.walk(fn):
+                if child is not fn and isinstance(child, _FN_NODES):
+                    nested.update(id(s) for s in ast.walk(child))
+                    nested.discard(id(child))  # the def itself scans later
+            if _has_atomic_commit(fi, fn):
+                continue
+            for call, handle in _open_bindings(fn):
+                if id(call) in nested:
+                    continue  # the enclosing walk reaches it via its own def
+                if not _handle_written(fn, handle, call):
+                    continue
+                yield Finding(
+                    path=fi.relpath,
+                    line=call.lineno,
+                    col=call.col_offset,
+                    rule=self.rule,
+                    message=(
+                        "non-atomic publication: this open(..., "
+                        f"'{_write_mode(call)}') writes a cross-process-read "
+                        "file in place — a concurrent reader can see it "
+                        "half-written; write to a tmp name and os.replace "
+                        "it into place in this function"
+                    ),
+                )
