@@ -197,6 +197,10 @@ class FleetWorker:
         self.lease: Optional[float] = None
         self.membership_epoch: Optional[int] = None
         self.rejoins = 0
+        # register() runs on the caller's thread *and* on the heartbeat
+        # thread (re-register after eviction); this lock keeps the
+        # lease / epoch / rejoins triple coherent across both.
+        self._state_lock = threading.Lock()
         self._interval = heartbeat_interval
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -208,9 +212,10 @@ class FleetWorker:
         })
         if reply.get("status") != "ok":
             raise RuntimeError(f"register rejected: {reply}")
-        self.lease = float(reply["lease"])
-        self.membership_epoch = int(reply["epoch"])
-        return self.membership_epoch
+        with self._state_lock:
+            self.lease = float(reply["lease"])
+            self.membership_epoch = int(reply["epoch"])
+            return self.membership_epoch
 
     def heartbeat(self) -> int:
         """One heartbeat round-trip; re-registers on eviction.  Returns the
@@ -219,12 +224,14 @@ class FleetWorker:
             {"action": "heartbeat", "worker_id": self.worker_id})
         if reply.get("status") == "unknown":
             # evicted (lease missed) — rejoin under the same id
-            self.rejoins += 1
+            with self._state_lock:
+                self.rejoins += 1
             return self.register()
         if reply.get("status") != "ok":
             raise RuntimeError(f"heartbeat rejected: {reply}")
-        self.membership_epoch = int(reply["epoch"])
-        return self.membership_epoch
+        with self._state_lock:
+            self.membership_epoch = int(reply["epoch"])
+            return self.membership_epoch
 
     def deregister(self) -> None:
         self._job._rpc(

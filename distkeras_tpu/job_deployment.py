@@ -357,8 +357,10 @@ class PunchcardServer:
                                      "last_heartbeat": self._job_heartbeat(job),
                                      "serve_flags": job.get("serve_flags")})
             elif action == "list":
+                with self._cv:
+                    serving_ids = set(self._serving)
                 for jid, j in list(self.jobs.items()):
-                    if jid in self._serving:
+                    if jid in serving_ids:
                         self._refresh_serving(jid, j)
                 send_data(conn, {"status": "ok",
                                  "jobs": {k: v["status"] for k, v in self.jobs.items()}})

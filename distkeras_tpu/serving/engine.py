@@ -486,7 +486,9 @@ class ServingEngine:
         from jax.sharding import PartitionSpec as P
 
         axis = self._tp_axis
-        specs = jax.tree.map(lambda _: P(), self._spec.params())
+        with self._cv:
+            spec = self._spec
+        specs = jax.tree.map(lambda _: P(), spec.params())
         for bs in specs["blocks"]:
             ap = bs["_SelfAttention_0"]
             ap["qkv"]["kernel"] = P(None, None, axis, None)
@@ -521,10 +523,12 @@ class ServingEngine:
         key = (role, width)
         fn = self._prefill_fns.get(key)
         if fn is None:
+            with self._cv:
+                spec = self._spec
             if role == "target":
                 fn = jax.jit(
                     self._maybe_shard(
-                        self._build_prefill(width, self._spec, sample=True,
+                        self._build_prefill(width, spec, sample=True,
                                             psum=self._psum),
                         n_rest=7, n_out=2),
                     donate_argnums=(1, 2))
@@ -807,23 +811,27 @@ class ServingEngine:
             return self._submit(request)
 
     def _submit(self, request: GenerateRequest) -> _Pending:
-        if self._crashed:
+        with self._cv:
+            # snapshot the published spec: hot-swap replaces it under _cv,
+            # so validating against a local ref sees one coherent geometry
+            crashed, spec = self._crashed, self._spec
+        if crashed:
             raise EngineCrashed("serving engine crashed; replica is dead")
         request.validate()
         plen = len(request.prompt)
-        if plen > self._width or plen >= self._spec.max_len:
+        if plen > self._width or plen >= spec.max_len:
             raise ValueError(
                 f"prompt length {plen} exceeds serviceable context "
-                f"(width {self._width}, model max_len {self._spec.max_len})"
+                f"(width {self._width}, model max_len {spec.max_len})"
             )
-        if int(np.max(request.prompt)) >= self._spec.vocab:
+        if int(np.max(request.prompt)) >= spec.vocab:
             raise ValueError("prompt token id out of vocabulary")
         if request.speculative and self._draft_spec is None:
             raise ValueError(
                 "request asks for speculative decoding but the engine was "
                 "built without a draft_model"
             )
-        max_new = min(request.max_new_tokens, self._spec.max_len - plen,
+        max_new = min(request.max_new_tokens, spec.max_len - plen,
                       self._width - plen)
         pending = _Pending(request, max_new, time.perf_counter())
         try:
@@ -863,13 +871,15 @@ class ServingEngine:
     def alive(self) -> bool:
         """``False`` once the loop has crashed — the health probe's fast
         path for telling "this replica is dead" from "this replica is slow"."""
-        return not self._crashed
+        with self._cv:
+            return not self._crashed
 
     @property
     def draining(self) -> bool:
         """Whether admission is paused (explicit :meth:`drain` or an
         in-flight :meth:`hot_swap`)."""
-        return self._draining or self._swap is not None
+        with self._cv:
+            return self._draining or self._swap is not None
 
     # ------------------------------------------------- tier hooks (host side)
 
@@ -917,9 +927,11 @@ class ServingEngine:
                 return True  # no loop ⇒ nothing in flight, nothing can admit
             deadline = time.perf_counter() + timeout
             while time.perf_counter() < deadline:
-                if not self._running:
+                with self._cv:
+                    running, acked = self._running, self._drain_ack
+                if not running:
                     return True  # stopped/crashed under us — slots are clear
-                if self._drain_ack and not self._active.any():
+                if acked and not self._active.any():
                     return True
                 time.sleep(0.002)
             return False
@@ -954,7 +966,8 @@ class ServingEngine:
 
     def _hot_swap(self, model, params, timeout: float) -> None:
         new = _resolve_spec(model, params)
-        old = self._spec
+        with self._cv:
+            old = self._spec
         for f in ("dim", "heads", "head_dim", "max_len", "vocab", "ln_eps"):
             if getattr(new, f) != getattr(old, f):
                 raise ValueError(
@@ -992,14 +1005,15 @@ class ServingEngine:
 
     def _loop(self) -> None:
         while True:
-            with self._cv:
-                if not self._running:
-                    return
-                self._drain_ack = self._draining
-                paused = self._draining or self._swap is not None
             try:
+                with self._cv:
+                    if not self._running:
+                        return
+                    self._drain_ack = self._draining
+                    swap_pending = self._swap is not None
+                    paused = self._draining or swap_pending
                 self._cancel_requested()
-                if self._swap is not None and not self._active.any():
+                if swap_pending and not self._active.any():
                     self._apply_swap()
                     with self._cv:
                         paused = self._draining
@@ -1010,15 +1024,15 @@ class ServingEngine:
                     # flight (the failover path is what's under test)
                     _chaos.fault("replica")
                 progressed = self._decode_once() or progressed
+                if not progressed:
+                    with self._cv:
+                        if (self._running and self._swap is None
+                                and not self._cancelled
+                                and (paused or len(self._queue) == 0)):
+                            self._cv.wait(timeout=0.05)
             except _chaos.ChaosKilled:
                 self._crash()
                 return
-            if not progressed:
-                with self._cv:
-                    if (self._running and self._swap is None
-                            and not self._cancelled
-                            and (paused or len(self._queue) == 0)):
-                        self._cv.wait(timeout=0.05)
 
     def _cancel_requested(self) -> None:
         """Retire every slot whose request was cancelled (loop thread only)."""
@@ -1040,9 +1054,11 @@ class ServingEngine:
 
     def _apply_swap(self) -> None:
         """Apply a pending hot-swap (loop thread, zero active slots)."""
-        spec, done = self._swap
-        self._spec = spec
         with self._cv:
+            if self._swap is None:
+                return  # hot_swap timed out and withdrew the request
+            spec, done = self._swap
+            self._spec = spec
             self._swap = None
         self._metrics["hot_swaps"].inc()
         done.set()

@@ -489,7 +489,11 @@ class ServingTier:
     def _probe_loop(self) -> None:
         stop = self._stop_evt
         while stop is not None and not stop.wait(self.probe_interval):
-            self.probe_once()
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — a failed sweep/export must
+                # not kill the supervisor; the next round retries it
+                continue
 
     # ------------------------------------------------------------- probing
 
@@ -563,7 +567,9 @@ class ServingTier:
     # ------------------------------------------------------------ dispatch
 
     def _pick(self, exclude: Dict[str, str]) -> Optional[_Entry]:
-        if not self._probed:
+        with self._cv:
+            probed = self._probed
+        if not probed:
             self.probe_once()
         with self._cv:
             pools: Dict[str, List[_Entry]] = {"healthy": [], "degraded": []}
@@ -802,18 +808,23 @@ class ServingTier:
 
         def _watch():
             while not stop.wait(poll_interval):
-                step = watcher.poll()
-                if step is None:
-                    continue
-                if verify_failure(directory, step, "full") is not None:
-                    self._metrics["ckpt_rejected"].inc()
-                    continue
                 try:
-                    model, params = loader(step)
-                    self.roll(model, params)
-                except Exception:  # noqa: BLE001 — a bad checkpoint must
-                    # not kill the watcher; the failure is already counted
-                    self._metrics["roll_failures"].inc()
+                    step = watcher.poll()
+                    if step is None:
+                        continue
+                    if verify_failure(directory, step, "full") is not None:
+                        self._metrics["ckpt_rejected"].inc()
+                        continue
+                    try:
+                        model, params = loader(step)
+                        self.roll(model, params)
+                    except Exception:  # noqa: BLE001 — a bad checkpoint
+                        # must not kill the watcher; counted separately
+                        self._metrics["roll_failures"].inc()
+                except Exception:  # noqa: BLE001 — a transient poll/verify
+                    # error (fs flake, torn manifest) must not kill the
+                    # watcher either; the next round re-polls
+                    continue
 
         thread = threading.Thread(
             target=_watch, name="serving-tier-ckpt-watch", daemon=True)
@@ -871,16 +882,17 @@ def watch_and_swap(engine, directory: str, loader,
 
     def _watch():
         while not stop.wait(poll_interval):
-            step = watcher.poll()
-            if step is None:
-                continue
-            if verify_failure(directory, step, "full") is not None:
-                _ckpt_rejected_counter().inc()
-                continue
             try:
+                step = watcher.poll()
+                if step is None:
+                    continue
+                if verify_failure(directory, step, "full") is not None:
+                    _ckpt_rejected_counter().inc()
+                    continue
                 model, params = loader(step)
                 engine.hot_swap(model, params)
-            except Exception:  # noqa: BLE001 — keep watching
+            except Exception:  # noqa: BLE001 — keep watching; a transient
+                # poll/verify error is retried next round
                 continue
 
     thread = threading.Thread(

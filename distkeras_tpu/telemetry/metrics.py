@@ -75,7 +75,8 @@ class Counter:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Gauge:
@@ -97,7 +98,8 @@ class Gauge:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Histogram:
@@ -126,11 +128,13 @@ class Histogram:
 
     @property
     def sum(self):
-        return self._sum
+        with self._lock:
+            return self._sum
 
     @property
     def count(self):
-        return self._count
+        with self._lock:
+            return self._count
 
     def cumulative(self):
         """[(upper_bound_label, cumulative_count), ...] ending with +Inf."""

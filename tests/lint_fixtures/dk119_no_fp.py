@@ -1,0 +1,107 @@
+"""DK119/DK120/DK121 no-false-positive corpus.
+
+Every pattern here is concurrency-correct and must stay finding-free:
+cv-wait handoff (both sides hold the condition), lockwatch-wrapped locks
+and guard_map'd containers, Event/Queue handoffs, and a handler thread
+that locks shared state properly.
+"""
+import threading
+from http.server import BaseHTTPRequestHandler
+
+from distkeras_tpu.utils.sanitizer import lockwatch
+
+
+class CvConsumer:
+    """Classic condition-variable queue: accesses on both roots hold _cv
+    (wait() releases and reacquires it, which the model understands)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+        self._thread = threading.Thread(target=self._consume, daemon=True)
+        self._thread.start()
+
+    def _consume(self):
+        while True:
+            try:
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait()
+                    item = self._items.pop()
+                self._handle(item)
+            except Exception:
+                continue
+
+    def _handle(self, item):
+        pass
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify_all()
+
+
+class GuardedState:
+    """lockwatch wrapper + guard_map container: wrapper-aware lock model."""
+
+    def __init__(self):
+        self._lock = lockwatch.maybe_wrap(threading.Lock(), "fixture")
+        self.table = lockwatch.guard_map({}, self._lock, "fixture.table")
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                with self._lock:
+                    self.table["beat"] = 1
+            except Exception:
+                continue
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self.table)
+
+
+class EventHandoff:
+    """Event/flag handoff where every shared access holds the same lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._result = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            try:
+                with self._lock:
+                    self._result = object()
+                self._done.set()
+            except Exception:
+                continue
+
+    def result(self):
+        self._done.wait()
+        with self._lock:
+            return self._result
+
+
+_registry_lock = threading.Lock()
+_registry = {"hits": 0}
+
+
+class StatusHandler(BaseHTTPRequestHandler):
+    """HTTP handler thread root: shared-registry access is locked on both
+    the handler side and the scrape side."""
+
+    def do_GET(self):
+        with _registry_lock:
+            _registry["hits"] += 1
+        self.send_response(200)
+
+
+def scrape():
+    with _registry_lock:
+        return dict(_registry)

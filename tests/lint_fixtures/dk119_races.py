@@ -1,0 +1,64 @@
+"""DK119 fixture: shared state crossing thread roots with disjoint locksets."""
+import threading
+
+
+class UnlockedCounter:
+    """Write on the spawned root with no lock at all — the write fires."""
+
+    def __init__(self):
+        self.counter = 0
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        while True:
+            try:
+                self.counter += 1  # line 16: DK119 write, empty lockset
+            except Exception:
+                continue
+
+    def read(self):
+        return self.counter
+
+
+class HalfLocked:
+    """Writer locks, reader doesn't — the unguarded read fires."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            try:
+                with self._lock:
+                    self.state = object()
+            except Exception:
+                continue
+
+    def read(self):
+        return self.state  # line 42: DK119 read, counterpart write is locked
+
+
+epoch_count = 0
+
+
+def _bump():
+    global epoch_count
+    while True:
+        try:
+            epoch_count += 1  # line 52: DK119 write on a module global
+        except Exception:
+            continue
+
+
+def spawn():
+    t = threading.Thread(target=_bump, daemon=True)
+    t.start()
+    return t
+
+
+def current():
+    return epoch_count

@@ -1,0 +1,42 @@
+"""DK120 fixture: acquisition-order cycles, direct and through a callee."""
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+lock_c = threading.Lock()
+lock_d = threading.Lock()
+
+
+def forward():
+    with lock_a:
+        with lock_b:  # line 12: a -> b
+            pass
+
+
+def backward():
+    with lock_b:
+        with lock_a:  # line 18: b -> a — closes the cycle
+            pass
+
+
+def outer():
+    with lock_c:
+        _nested()  # c -> d through the callee
+
+
+def _nested():
+    with lock_d:
+        pass
+
+
+def inverted():
+    with lock_d:
+        with lock_c:  # line 33: d -> c — closes the interprocedural cycle
+            pass
+
+
+def ordered_only():
+    """Consistent order everywhere — no finding."""
+    with lock_a:
+        with lock_c:
+            pass

@@ -1,0 +1,37 @@
+"""DK121 fixture: thread-lifecycle hygiene — join discipline and loop
+exception containment."""
+import threading
+
+
+def spawn_unjoined():
+    orphan = threading.Thread(target=_work)  # line 7: non-daemon, never joined
+    orphan.start()
+    return orphan
+
+
+def _work():
+    while True:  # line 13: runner loop without exception containment
+        _step()
+
+
+def _step():
+    pass
+
+
+def spawn_joined():
+    t = threading.Thread(target=_careful)
+    t.start()
+    t.join()
+
+
+def spawn_daemon():
+    t = threading.Thread(target=_careful, daemon=True)
+    t.start()
+
+
+def _careful():
+    while True:  # contained body — no finding
+        try:
+            _step()
+        except Exception:
+            continue

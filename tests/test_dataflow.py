@@ -324,6 +324,80 @@ def test_may_follow_early_return_blocks_later_use():
     assert not flow.may_follow(first, second)  # first path returned already
 
 
+def test_may_follow_try_finally_exits():
+    """Lockset correctness across release-on-exception paths: the finally
+    suite follows both the try body and every handler, and code after the
+    try follows the finally."""
+    flow = _flow(
+        "def f(key, c):\n"
+        "    try:\n"
+        "        a = uniform(key)\n"
+        "    except ValueError:\n"
+        "        b = normal(key)\n"
+        "    finally:\n"
+        "        c = fold_in(key)\n"
+        "    return split(key)\n"
+    )
+    body_use, handler_use, finally_use, after_use = _uses_of(flow, "key")
+    assert flow.may_follow(body_use, handler_use)    # body may raise into it
+    assert flow.may_follow(body_use, finally_use)
+    assert flow.may_follow(handler_use, finally_use)
+    assert flow.may_follow(finally_use, after_use)
+    assert not flow.may_follow(handler_use, body_use)
+    assert not flow.may_follow(finally_use, body_use)
+
+
+def test_may_follow_handlers_are_exclusive_siblings():
+    """Handler A's fallout never reaches handler B — they are alternative
+    catches of the same body, not a chain."""
+    flow = _flow(
+        "def f(key):\n"
+        "    try:\n"
+        "        a = uniform(key)\n"
+        "    except ValueError:\n"
+        "        b = normal(key)\n"
+        "    except KeyError:\n"
+        "        c = bernoulli(key)\n"
+        "    return a\n"
+    )
+    body_use, first_handler, second_handler = _uses_of(flow, "key")
+    assert flow.may_follow(body_use, first_handler)
+    assert flow.may_follow(body_use, second_handler)
+    assert not flow.may_follow(first_handler, second_handler)
+    assert not flow.may_follow(second_handler, first_handler)
+
+
+def test_may_follow_with_suite_exit():
+    """Code after a with-block follows the suite body — the context exit
+    is a fall-through, not a barrier (this is what lets a lockset drop
+    back to the pre-acquire set after the suite)."""
+    flow = _flow(
+        "def f(key, lk):\n"
+        "    with lk:\n"
+        "        a = uniform(key)\n"
+        "    return normal(key)\n"
+    )
+    inside, after = _uses_of(flow, "key")
+    assert flow.may_follow(inside, after)
+    assert not flow.may_follow(after, inside)
+
+
+def test_may_follow_return_bypasses_finally_ordering():
+    """A Return inside try exits via the CFG's exit node: a use *after*
+    the whole try/finally statement is unreachable from it."""
+    flow = _flow(
+        "def f(key, c):\n"
+        "    try:\n"
+        "        if c:\n"
+        "            return uniform(key)\n"
+        "    finally:\n"
+        "        pass\n"
+        "    return normal(key)\n"
+    )
+    returned, after = _uses_of(flow, "key")
+    assert not flow.may_follow(returned, after)
+
+
 # ------------------------------------------------------------ small tools
 
 def test_expr_uses_skips_nested_lambda_bodies():

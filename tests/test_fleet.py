@@ -187,6 +187,50 @@ def test_fleet_worker_rejoins_after_eviction(daemon):
         assert w.worker_id in daemon.fleet.members
 
 
+def test_fleet_worker_membership_state_coherent_under_concurrency(daemon):
+    """DK119 regression: lease/membership_epoch/rejoins are written on the
+    caller's thread (register) *and* the heartbeat thread (re-register
+    after eviction); both paths now update under _state_lock, so a burst
+    of concurrent heartbeats and evictions never corrupts the triple or
+    loses a rejoin increment."""
+    import threading as _threading
+
+    w = _worker(daemon)
+    w.register()
+    stop = _threading.Event()
+    errs = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                w.heartbeat()
+            except Exception as e:  # noqa: BLE001 — any error fails the test
+                errs.append(e)
+                return
+
+    threads = [_threading.Thread(target=hammer, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(10):  # force evictions racing the heartbeats
+            with daemon._cv:
+                daemon.fleet.members.pop(w.worker_id, None)
+                daemon.fleet.epoch += 1
+            time.sleep(0.005)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    assert not errs, errs
+    with w._state_lock:  # the triple is always observed whole
+        assert isinstance(w.lease, float)
+        assert isinstance(w.membership_epoch, int)
+        assert w.rejoins >= 1
+    with daemon._cv:
+        assert w.membership_epoch <= daemon.fleet.epoch
+
+
 def test_elastic_membership_survives_daemon_outage():
     poller = fleet.ElasticMembership("127.0.0.1", 1, secret="")
     assert poller.poll() is None  # unreachable daemon is not a resize

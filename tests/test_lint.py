@@ -510,6 +510,65 @@ def test_dk118_out_of_scope_module_is_silent(tmp_path):
     assert findings == []
 
 
+def test_dk119_shared_state_race_fixture():
+    got, _ = _run("dk119_races.py", ["DK119"])
+    assert got == [
+        ("DK119", 16),  # unlocked write on the spawned root
+        ("DK119", 42),  # unguarded read vs a locked writer
+        ("DK119", 52),  # unlocked write on a module global
+    ]
+
+
+def test_dk120_lock_order_fixture():
+    got, _ = _run("dk120_lock_order.py", ["DK120"])
+    assert got == [
+        ("DK120", 12),  # a -> b leg of the direct cycle
+        ("DK120", 18),  # b -> a leg of the direct cycle
+        ("DK120", 24),  # c -> d through the callee
+        ("DK120", 34),  # d -> c closing the interprocedural cycle
+    ]
+
+
+def test_dk121_thread_lifecycle_fixture():
+    got, _ = _run("dk121_lifecycle.py", ["DK121"])
+    assert got == [
+        ("DK121", 7),   # non-daemon thread never joined
+        ("DK121", 13),  # runner loop without exception containment
+    ]
+
+
+def test_dk121_joined_and_daemon_threads_are_silent():
+    got, _ = _run("dk121_lifecycle.py", ["DK121"])
+    lines = [ln for _, ln in got]
+    assert 22 not in lines  # joined non-daemon thread
+    assert 28 not in lines  # daemon thread
+    assert 33 not in lines  # contained runner loop
+
+
+def test_fixed_modules_stay_concurrency_clean():
+    """Regression pins for the in-tree fixes: modules whose DK119/DK120/
+    DK121 findings were *fixed* (not baselined) must stay clean when
+    analyzed alone, with no baseline applied.  (tier.py and engine.py keep
+    justified Event-handoff / internally-locked-queue entries in the main
+    baseline and are pinned by the package gate instead.)"""
+    for mod in ("distkeras_tpu/fleet.py",
+                "distkeras_tpu/telemetry/metrics.py",
+                "distkeras_tpu/job_deployment.py"):
+        findings, _ = analyze([os.path.join(REPO_ROOT, mod)], root=REPO_ROOT,
+                              select=["DK119", "DK120", "DK121"])
+        assert findings == [], mod + ":\n" + "\n".join(
+            f.render() for f in findings)
+
+
+def test_concurrency_no_false_positive_corpus():
+    """The pinned clean corpus: cv-wait (both sides hold the condition),
+    lockwatch maybe_wrap/guard_map state, Event handoff with locked
+    accesses, and a handler thread with locked registry access must all
+    stay finding-free under every concurrency rule."""
+    got, _ = _run("dk119_no_fp.py", ["DK119", "DK120", "DK121"])
+    assert got == []
+
+
 def test_dk115_out_of_scope_module_is_silent(tmp_path):
     """Same code outside the daemon/server scope stays unflagged — batch
     code may legitimately block forever."""
@@ -637,7 +696,7 @@ def test_all_rules_registered():
     assert sorted(all_rules()) == [
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
         "DK108", "DK109", "DK110", "DK111", "DK112", "DK113", "DK114",
-        "DK115", "DK116", "DK117", "DK118",
+        "DK115", "DK116", "DK117", "DK118", "DK119", "DK120", "DK121",
     ]
 
 
@@ -864,6 +923,51 @@ def test_cli_since_with_root_below_git_toplevel(tmp_path):
     assert out.returncode == 1, out.stdout + out.stderr
     payload = json.loads(out.stdout)
     assert [(f["path"], f["rule"]) for f in payload] == [("mod.py", "DK102")]
+
+
+def test_cli_since_follows_renames(tmp_path):
+    """A file renamed since the ref must lint under its *new* path — the
+    pre-rename diff leg dropped renamed files silently (no R-row parsing)."""
+    _git(tmp_path, "init", "-q")
+    old = tmp_path / "old_name.py"
+    old.write_text(
+        "import jax\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n"
+    )
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    _git(tmp_path, "mv", "old_name.py", "new_name.py")
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", ".", "--no-baseline",
+         "--root", str(tmp_path), "--since", "HEAD", "--format", "json"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert [(f["path"], f["rule"]) for f in payload] == [
+        ("new_name.py", "DK102")
+    ]
+
+
+def test_changed_files_reports_both_sides_of_a_rename(tmp_path):
+    from tools.dklint.cli import changed_files
+
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "a.py").write_text("x = 1\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    _git(tmp_path, "mv", "a.py", "b.py")
+    changed = changed_files(str(tmp_path), "HEAD")
+    assert {"a.py", "b.py"} <= changed
+
+
+def test_analyze_jobs_matches_sequential():
+    """--jobs fan-out must be invisible in the output: identical findings,
+    identical order."""
+    seq, _ = analyze([FIXTURES], root=REPO_ROOT)
+    par, _ = analyze([FIXTURES], root=REPO_ROOT, jobs=2)
+    assert par == seq
+    assert seq  # non-vacuous: the fixture tree fires plenty
 
 
 def test_cli_since_bad_ref_is_usage_error(tmp_path):

@@ -340,6 +340,68 @@ def test_watch_and_swap_follows_committed_checkpoints(lm, tmp_path):
     engine.stop()
 
 
+def test_watch_and_swap_survives_raising_poll(lm, tmp_path, monkeypatch):
+    """DK121 regression: a transient poll/verify error (fs flake, torn
+    manifest) must not kill the watcher thread — the next round re-polls
+    and a later publication still swaps."""
+    from distkeras_tpu import checkpoint as ckpt_mod
+
+    module, params = lm
+    params2 = module.init(jax.random.PRNGKey(9),
+                          np.zeros((1, 4), np.int32))["params"]
+    registry = Registry()
+    engine = ServingEngine(module, params, num_slots=2, page_size=8,
+                           registry=registry)
+    real_poll = ckpt_mod.CheckpointWatcher.poll
+    calls = []
+
+    def flaky_poll(self):
+        calls.append(1)
+        if len(calls) % 2 == 1:  # every other round blows up
+            raise RuntimeError("transient fs flake")
+        return real_poll(self)
+
+    monkeypatch.setattr(ckpt_mod.CheckpointWatcher, "poll", flaky_poll)
+    stopper = watch_and_swap(engine, str(tmp_path),
+                             lambda step: (module, params2),
+                             poll_interval=0.02)
+    try:
+        _publish_step(tmp_path, 12)
+        deadline = time.monotonic() + 30
+        while (_ctr(registry, "serving_hot_swaps_total") < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+    finally:
+        stopper()
+    assert _ctr(registry, "serving_hot_swaps_total") == 1
+    assert len(calls) >= 2  # the raising rounds did not kill the watcher
+    engine.stop()
+
+
+def test_probe_loop_survives_probe_exception(lm, make_tier, monkeypatch):
+    """DK121 regression: an exception escaping a probe round (e.g. a
+    failed sweep/export) must not kill the supervision thread."""
+    tier = make_tier(_engines(lm, 1), probe_interval=0.01)
+    calls = []
+    real = ServingTier.probe_once
+
+    def flaky(self):
+        calls.append(1)
+        if len(calls) == 2:
+            raise RuntimeError("export flaked")
+        return real(self)
+
+    monkeypatch.setattr(ServingTier, "probe_once", flaky)
+    tier.start()  # round 1 runs synchronously and succeeds
+    deadline = time.monotonic() + 30
+    while len(calls) < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(calls) >= 4  # round 2 raised; rounds 3+ still happened
+    with tier._cv:
+        thread = tier._probe_thread
+    assert thread is not None and thread.is_alive()
+
+
 def test_checkpoint_watcher_reports_newest_once(tmp_path):
     _publish_step(tmp_path, 3)
     watcher = CheckpointWatcher(str(tmp_path))

@@ -1,0 +1,32 @@
+"""DK120 — static lock-order inversion.
+
+Builds a cross-function acquisition-order graph from the shared
+concurrency model (edges: lock B acquired — directly or transitively via
+a call — while lock A is held) and flags every edge that closes a cycle.
+Complements lockwatch's runtime inversion graph: this one sees orderings
+the test suite never executes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tools.dklint import concurrency
+from tools.dklint.core import Checker, FileInfo, Finding, Project
+from tools.dklint.registry import register
+
+
+@register
+class LockOrderChecker(Checker):
+    rule = "DK120"
+    name = "lock-order-inversion"
+    description = (
+        "two locks acquired in opposite orders on different code paths "
+        "(cross-function acquisition-order cycle)"
+    )
+
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        concurrency.collect_facts(project, fi)
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        return concurrency.findings_for(project, fi, self.rule)

@@ -1,0 +1,33 @@
+"""DK119 — shared-state race: an attribute or mutable global written on
+one thread root and read/written on another with disjoint locksets.
+
+The static twin of lockwatch's runtime off-lock-mutation check.  All the
+heavy lifting — thread-root discovery, escape analysis, per-access
+locksets with entry-lockset propagation — lives in
+:mod:`tools.dklint.concurrency`; this checker just surfaces the per-file
+finding lists the shared model computed.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tools.dklint import concurrency
+from tools.dklint.core import Checker, FileInfo, Finding, Project
+from tools.dklint.registry import register
+
+
+@register
+class SharedStateRaceChecker(Checker):
+    rule = "DK119"
+    name = "shared-state-race"
+    description = (
+        "attribute/global written on one thread root and accessed on "
+        "another with no common lock (static twin of lockwatch)"
+    )
+
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        concurrency.collect_facts(project, fi)
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        return concurrency.findings_for(project, fi, self.rule)

@@ -1,0 +1,35 @@
+"""DK121 — thread-lifecycle hygiene.
+
+Two legs, both over the shared concurrency model's thread-site table:
+
+* a **non-daemon** thread that is never ``join``-ed (nor stopped through
+  a bound handle) hangs interpreter shutdown;
+* a **runner loop** (a ``while`` loop at the top level of a thread
+  target) whose body has statements outside any ``try/except`` dies
+  silently on the first exception — the respawn/watcher supervision
+  pattern requires the loop body to contain its failures.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tools.dklint import concurrency
+from tools.dklint.core import Checker, FileInfo, Finding, Project
+from tools.dklint.registry import register
+
+
+@register
+class ThreadLifecycleChecker(Checker):
+    rule = "DK121"
+    name = "thread-lifecycle"
+    description = (
+        "non-daemon thread with no join/stop on a shutdown path, or a "
+        "runner loop body without exception containment"
+    )
+
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        concurrency.collect_facts(project, fi)
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        return concurrency.findings_for(project, fi, self.rule)

@@ -21,27 +21,49 @@ DEFAULT_BASELINE = os.path.join("tools", "dklint", "baseline.json")
 
 def changed_files(root: str, ref: str) -> Set[str]:
     """Root-relative (forward-slash) paths changed vs. ``ref``, plus
-    untracked files — the PR-diff set ``--since`` filters findings to."""
+    untracked files — the PR-diff set ``--since`` filters findings to.
+
+    The diff runs with rename detection (``--name-status -M``) so a file
+    renamed on the PR branch is linted under its *new* path instead of
+    silently dropping out of the diff leg; both sides of an R/C row are
+    kept (findings live at the new path, baseline entries may still name
+    the old one)."""
     out: Set[str] = set()
-    for cmd in (
-        # --relative: diff paths come back relative to cwd (= root), like
-        # ls-files already does — findings are root-relative, and without
-        # it a --root below the git toplevel would never match anything
-        ["git", "diff", "--name-only", "--relative", ref, "--"],
-        ["git", "ls-files", "--others", "--exclude-standard"],
-    ):
-        proc = subprocess.run(
-            cmd, cwd=root, capture_output=True, text=True, timeout=60,
+    # --relative: diff paths come back relative to cwd (= root), like
+    # ls-files already does — findings are root-relative, and without
+    # it a --root below the git toplevel would never match anything
+    diff_cmd = ["git", "diff", "--name-status", "-M", "--relative", ref, "--"]
+    proc = subprocess.run(
+        diff_cmd, cwd=root, capture_output=True, text=True, timeout=60,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"`{' '.join(diff_cmd)}` failed: "
+            f"{proc.stderr.strip() or 'unknown error'}"
         )
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"`{' '.join(cmd)}` failed: {proc.stderr.strip() or 'unknown error'}"
-            )
-        out.update(
-            line.strip().replace(os.sep, "/")
-            for line in proc.stdout.splitlines()
-            if line.strip()
+    for line in proc.stdout.splitlines():
+        if not line.strip():
+            continue
+        parts = line.split("\t")
+        status = parts[0].strip()
+        # R<score>/C<score> rows carry "old\tnew"; everything else one path
+        paths = parts[1:] if status[:1] in ("R", "C") else parts[1:2]
+        out.update(p.strip().replace(os.sep, "/") for p in paths if p.strip())
+
+    ls_cmd = ["git", "ls-files", "--others", "--exclude-standard"]
+    proc = subprocess.run(
+        ls_cmd, cwd=root, capture_output=True, text=True, timeout=60,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"`{' '.join(ls_cmd)}` failed: "
+            f"{proc.stderr.strip() or 'unknown error'}"
         )
+    out.update(
+        line.strip().replace(os.sep, "/")
+        for line in proc.stdout.splitlines()
+        if line.strip()
+    )
     return out
 
 
@@ -119,6 +141,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prune-baseline", action="store_true",
                    help="drop baseline entries that no longer match any "
                         "finding (keeps reasons on the survivors) and exit 0")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="fan the per-file check pass out over N worker "
+                        "processes (collect stays whole-program in each; "
+                        "output is identical to a sequential run)")
     p.add_argument("--since", default=None, metavar="GIT_REF",
                    help="report findings only for files changed vs. this git "
                         "ref (the whole tree is still analyzed, so "
@@ -143,7 +169,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     root = os.path.abspath(args.root or os.getcwd())
     select = [s for s in (args.select or "").split(",") if s] or None
     try:
-        findings, files = core.analyze(args.paths, root=root, select=select)
+        findings, files = core.analyze(args.paths, root=root, select=select,
+                                       jobs=args.jobs)
     except (FileNotFoundError, ValueError) as e:
         print(f"dklint: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,1211 @@
+"""Whole-program concurrency model for dklint (DK119 / DK120 / DK121).
+
+Three layers, all static and stdlib-``ast`` only, shared by the
+``races`` / ``lock_order`` / ``thread_lifecycle`` checkers:
+
+**Thread roots** — every ``threading.Thread(target=...)`` call site is
+resolved to its target function (bare name, ``self.method``, dotted
+``mod.fn`` through the per-file import map, or an inline ``lambda``);
+every method of a ``*RequestHandler`` class is a handler root (threaded
+HTTP/socket servers run one handler per request thread); everything not
+nested inside one of those seeds belongs to the synthetic ``main`` root.
+Each root is closed over the call graph (local names, ``self.*`` methods,
+cross-module calls via ``FileInfo.imports``) and over lexical nesting,
+with every *other* root's seed acting as a barrier — a nested daemon
+body like ``def _beat()`` inside ``start()`` belongs to its own root,
+not to the root that spawned it.
+
+**Escape analysis** — a key (``self.<attr>`` scoped by class, or a
+module global named in a ``global`` statement) is shared when functions
+from two distinct roots access it.  Attributes holding synchronisation
+or handoff objects (locks, conditions, ``GuardedLock``/``GuardedMap``,
+``Event``, ``Queue``, ``deque``, ``Thread``) are never keys themselves.
+
+**Locksets** — per access, the set of lock tokens lexically held
+(``with self.lock:`` blocks, balanced ``acquire()``/``release()`` pairs,
+including across ``try/finally``) plus the *entry lockset*: the
+intersection of the locksets at every resolved call site of the owning
+function, computed to a fixpoint.  That is what keeps the documented
+"callers hold the condition variable" pattern (``FleetMembership``)
+quiet without annotations.  ``cv.wait()`` needs no special casing: the
+lock is re-acquired before ``wait`` returns, so accesses after the wait
+are correctly modelled as held.
+
+Deliberate engineering limits, chosen to keep the false-positive rate
+near zero (each is pinned by the no-FP fixture corpus):
+
+* accesses in constructor/teardown-shaped methods (``__init__``,
+  ``close``, ``stop``, ``start``, ...) are exempt — spawn
+  happens-before and join happens-after order them;
+* ALL_CAPS attributes/globals are treated as constants;
+* files named ``test_*.py`` contribute to the model but never receive
+  findings (pytest bodies join their threads; flagging them is noise);
+* a function's entry lockset trusts in-tree call sites — an external
+  caller could race, but that is the documented contract boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.dklint import dataflow
+from tools.dklint.core import FileInfo, Finding, Project, call_name, dotted_name
+from tools.dklint.checkers.host_sync import _modules_match
+from tools.dklint.checkers.locks import (
+    CONSTRUCTORS,
+    LOCK_FACTORIES,
+    MUTATING_METHODS,
+    _self_attr,
+)
+
+FACTS_KEY = "DKCONC.facts"
+MODEL_KEY = "DKCONC.model"
+
+THREAD_CALLS = {"threading.Thread", "Thread"}
+
+# lockwatch wrappers wrap a real lock and stay lock-like
+LOCK_WRAPPERS = {
+    "lockwatch.maybe_wrap", "maybe_wrap",
+    "lockwatch.GuardedLock", "GuardedLock",
+    "sanitizer.lockwatch.maybe_wrap", "sanitizer.lockwatch.GuardedLock",
+}
+
+# runtime-guarded containers: every access goes through the wrapper's own
+# lock discipline, so the static model must not double-report them
+GUARDED_FACTORIES = {
+    "lockwatch.guard_map", "guard_map",
+    "lockwatch.GuardedMap", "GuardedMap",
+    "sanitizer.lockwatch.guard_map", "sanitizer.lockwatch.GuardedMap",
+}
+
+# thread-safe handoff primitives; also Thread objects themselves
+SAFE_FACTORIES = {
+    "threading.Event", "Event",
+    "queue.Queue", "Queue", "queue.SimpleQueue", "SimpleQueue",
+    "queue.LifoQueue", "queue.PriorityQueue",
+    "collections.deque", "deque",
+    "threading.Thread", "Thread",
+    "threading.Timer", "Timer",
+}
+
+# spawn happens-before the thread runs; join happens-after it exits —
+# accesses inside these methods are sequenced by construction/teardown
+EXEMPT_METHODS = CONSTRUCTORS | {
+    "__del__", "__enter__", "__exit__",
+    "close", "stop", "shutdown", "start", "join", "terminate", "halt",
+}
+
+_HANDLER_BASES = ("RequestHandler",)
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# name shapes that denote a lock when the object itself can't be typed
+_LOCKISH = ("lock", "mutex", "cv", "cond", "sem", "guard")
+
+
+def _lockish_name(name: str) -> bool:
+    low = name.lower()
+    return any(part in low for part in _LOCKISH)
+
+
+# tokens/keys: ("attr", class_qual, name) | ("global", module, name) |
+# ("local", fn_key, name) — locals participate in locksets but not in the
+# DK120 order graph (no cross-function identity)
+Token = Tuple[str, str, str]
+
+
+class Access:
+    __slots__ = ("key", "kind", "lockset", "relpath", "line", "col",
+                 "fn_id", "roots")
+
+    def __init__(self, key: Token, kind: str, lockset: FrozenSet[Token],
+                 relpath: str, line: int, col: int, fn_id: int):
+        self.key = key
+        self.kind = kind  # "read" | "write"
+        self.lockset = lockset
+        self.relpath = relpath
+        self.line = line
+        self.col = col
+        self.fn_id = fn_id
+        self.roots: FrozenSet[str] = frozenset()
+
+
+class ThreadSite:
+    __slots__ = ("node", "spec", "daemon", "bound", "fn_id", "relpath")
+
+    def __init__(self, node: ast.Call, spec, fn_id: int, relpath: str):
+        self.node = node
+        self.spec = spec        # ("bare", n) | ("self", n) | ("dotted", s)
+                                # | ("lambda", ast.Lambda)
+        self.daemon = False
+        self.bound = None       # ("local", name) | ("attr", name) | None
+        self.fn_id = fn_id
+        self.relpath = relpath
+
+
+class ClassConc:
+    __slots__ = ("qual", "lock_attrs", "guarded_attrs", "safe_attrs",
+                 "methods", "is_handler")
+
+    def __init__(self, qual: str):
+        self.qual = qual
+        self.lock_attrs: Set[str] = set()
+        self.guarded_attrs: Set[str] = set()
+        self.safe_attrs: Set[str] = set()
+        self.methods: Set[str] = set()
+        self.is_handler = False
+
+
+# ------------------------------------------------------------------ indexing
+
+class _Index(ast.NodeVisitor):
+    """Functions and classes of one module, with enough context to scope
+    ``self.<attr>`` keys: which class a method's ``self`` refers to (nested
+    closures inherit the enclosing method's ``self``)."""
+
+    def __init__(self, module: str):
+        self.module = module
+        self.fns: List[ast.AST] = []
+        self.parents: Dict[int, Optional[int]] = {}
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        self.name_of: Dict[int, str] = {}
+        self.self_class: Dict[int, str] = {}   # id(fn) -> class qual or ""
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.method_of: Dict[Tuple[str, str], ast.AST] = {}
+        self._scope: List[Tuple[str, object]] = []  # ("c", qual) | ("f", fn)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        qual = self.module + "." + ".".join(
+            [q for k, q in self._scope if k == "c"] + [node.name]
+        ) if self.module else node.name
+        self.classes[qual] = node
+        self._scope.append(("c", node.name))
+        self._qual_stack = qual
+        for child in node.body:
+            self._cur_class = qual
+            self.visit(child)
+        self._scope.pop()
+
+    def _enter_fn(self, node: ast.AST, name: str) -> None:
+        self.fns.append(node)
+        self.name_of[id(node)] = name
+        self.by_name.setdefault(name, []).append(node)
+        parent_fn = next(
+            (v for k, v in reversed(self._scope) if k == "f"), None
+        )
+        self.parents[id(node)] = id(parent_fn) if parent_fn is not None else None
+        if self._scope and self._scope[-1][0] == "c":
+            qual = getattr(self, "_cur_class", "")
+            self.self_class[id(node)] = qual
+            self.method_of[(qual, name)] = node
+        elif parent_fn is not None:
+            self.self_class[id(node)] = self.self_class.get(id(parent_fn), "")
+        else:
+            self.self_class[id(node)] = ""
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_fn(node, node.name)
+        self._scope.append(("f", node))
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_fn(node, "<lambda>")
+        self._scope.append(("f", node))
+        self.generic_visit(node)
+        self._scope.pop()
+
+
+def _class_conc(qual: str, cls: ast.ClassDef) -> ClassConc:
+    info = ClassConc(qual)
+    for base in cls.bases:
+        name = dotted_name(base) or ""
+        if name.endswith(_HANDLER_BASES):
+            info.is_handler = True
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods.add(node.name)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        cname = call_name(node.value)
+        for target in targets:
+            attr = _self_attr(target)
+            if not attr:
+                continue
+            if cname in LOCK_FACTORIES or cname in LOCK_WRAPPERS:
+                info.lock_attrs.add(attr)
+            elif cname in GUARDED_FACTORIES:
+                info.guarded_attrs.add(attr)
+            elif cname in SAFE_FACTORIES:
+                info.safe_attrs.add(attr)
+    return info
+
+
+# ---------------------------------------------------------------- fn scanning
+
+class _FnScan:
+    """One function's concurrency-relevant events: shared-state accesses
+    with their lexical locksets, lock acquisitions (with what was already
+    held), resolved-later call sites (with the lockset at the call), thread
+    creations, and ``.join()`` observations."""
+
+    def __init__(self, fi: FileInfo, fn: ast.AST, cls: Optional[ClassConc],
+                 facts: dict):
+        self.fi = fi
+        self.fn = fn
+        self.cls = cls
+        self.facts = facts
+        self.accesses: List[Access] = []
+        self.acquisitions: List[Tuple[Token, FrozenSet[Token], ast.AST]] = []
+        self.call_sites: List[Tuple[tuple, FrozenSet[Token], ast.AST]] = []
+        self.thread_sites: List[ThreadSite] = []
+        self._flow: Optional[dataflow.FunctionFlow] = None
+        self._globals_declared: Set[str] = set()
+        self._last_thread: Optional[ThreadSite] = None
+        self._nested: Set[int] = set()
+        for child in ast.walk(fn):
+            if child is not fn and isinstance(child, _FN_NODES):
+                for sub in ast.walk(child):
+                    self._nested.add(id(sub))
+        for node in ast.walk(fn):
+            if id(node) not in self._nested and isinstance(node, ast.Global):
+                self._globals_declared.update(node.names)
+
+    # -- entry point
+
+    def run(self) -> None:
+        body = self.fn.body if isinstance(self.fn.body, list) else None
+        if body is None:  # Lambda
+            self._expr(self.fn.body, frozenset())
+            return
+        self._block(body, frozenset())
+        self._fix_daemon_flags()
+
+    def flow(self) -> dataflow.FunctionFlow:
+        if self._flow is None:
+            self._flow = dataflow.function_flow(self.fn)
+        return self._flow
+
+    # -- statement walk with lockset threading
+
+    def _block(self, stmts: Sequence[ast.stmt],
+               held: FrozenSet[Token]) -> FrozenSet[Token]:
+        for stmt in stmts:
+            held = self._stmt(stmt, held)
+        return held
+
+    def _stmt(self, stmt: ast.stmt, held: FrozenSet[Token]) -> FrozenSet[Token]:
+        if isinstance(stmt, _FN_NODES + (ast.ClassDef,)):
+            for dec in getattr(stmt, "decorator_list", []):
+                self._expr(dec, held)
+            return held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            toks: List[Token] = []
+            for item in stmt.items:
+                tok = self._lock_token(item.context_expr)
+                if tok is not None:
+                    self._acquire(tok, held | frozenset(toks), item.context_expr)
+                    toks.append(tok)
+                else:
+                    self._expr(item.context_expr, held)
+            self._block(stmt.body, held | frozenset(toks))
+            return held
+        if isinstance(stmt, ast.Try):
+            body_held = self._block(stmt.body, held)
+            for handler in stmt.handlers:
+                self._expr(handler.type, held)
+                self._block(handler.body, held)
+            if stmt.orelse:
+                body_held = self._block(stmt.orelse, body_held)
+            if stmt.finalbody:
+                return self._block(stmt.finalbody, body_held)
+            return body_held
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, held)
+            self._block(stmt.body, held)
+            self._block(stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, held)
+            self._block(stmt.body, held)
+            self._block(stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, held)
+            self._block(stmt.body, held)
+            self._block(stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.Assign):
+            held = self._maybe_acquire_release(stmt.value, held, stmt)
+            self._expr(stmt.value, held)
+            if self._last_thread is not None and stmt.targets:
+                self._bind_thread(stmt.targets[0])
+            for target in stmt.targets:
+                self._store(target, stmt, held)
+            return held
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value, held)
+            self._read_of_target(stmt.target, held)
+            self._store(stmt.target, stmt, held)
+            return held
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+                self._store(stmt.target, stmt, held)
+            return held
+        if isinstance(stmt, ast.Expr):
+            new_held = self._maybe_acquire_release(stmt.value, held, stmt)
+            self._expr(stmt.value, held)
+            return new_held
+        if isinstance(stmt, (ast.Return, ast.Raise, ast.Assert, ast.Delete)):
+            self._expr(stmt, held)
+            return held
+        # Pass / Break / Continue / Global / Nonlocal / Import...
+        self._expr(stmt, held)
+        return held
+
+    def _maybe_acquire_release(self, expr: ast.AST, held: FrozenSet[Token],
+                               site: ast.AST) -> FrozenSet[Token]:
+        """``lock.acquire()`` / ``lock.release()`` as a statement (or the
+        RHS of ``ok = lock.acquire(timeout=...)``) updates the running
+        lockset; the with-statement path above handles everything else."""
+        if not (isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute)):
+            return held
+        if expr.func.attr not in ("acquire", "release"):
+            return held
+        tok = self._lock_token(expr.func.value)
+        if tok is None:
+            return held
+        if expr.func.attr == "acquire":
+            self._acquire(tok, held, site)
+            return held | {tok}
+        return held - {tok}
+
+    def _acquire(self, tok: Token, held: FrozenSet[Token], node: ast.AST) -> None:
+        self.acquisitions.append((tok, held, node))
+
+    # -- expression walk
+
+    def _expr(self, node: Optional[ast.AST], held: FrozenSet[Token]) -> None:
+        if node is None or id(node) in self._nested:
+            return
+        if isinstance(node, _FN_NODES):
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr:
+                self._attr_access(attr, node, "read", held)
+                return
+            self._expr(node.value, held)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                self._global_access(node, "read", held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, held)
+
+    def _call(self, node: ast.Call, held: FrozenSet[Token]) -> None:
+        cname = call_name(node)
+        if cname in THREAD_CALLS:
+            self._thread_create(node)
+        func = node.func
+        if isinstance(func, ast.Name):
+            self.call_sites.append((("bare", func.id), held, node))
+        elif isinstance(func, ast.Attribute):
+            base, meth = func.value, func.attr
+            self_attr = _self_attr(func)  # self.m(...)
+            if self_attr:
+                if self.cls is not None and self_attr in self.cls.methods:
+                    self.call_sites.append((("self", self_attr), held, node))
+                elif not (self.cls is not None and self_attr in self.cls.lock_attrs):
+                    # callable attribute (callbacks): a read of the slot
+                    self._attr_access(self_attr, func, "read", held)
+            elif _self_attr(base):  # self.X.m(...)
+                X = _self_attr(base)
+                if meth == "join":
+                    self.facts["joined_attrs"].add(X)
+                kind = "write" if meth in MUTATING_METHODS else "read"
+                self._attr_access(X, base, kind, held)
+            elif isinstance(base, ast.Name):
+                if meth == "join":
+                    self.facts["joined_names"].add(base.id)
+                dotted = dotted_name(func)
+                if dotted:
+                    self.call_sites.append((("dotted", dotted), held, node))
+                self._expr(base, held)
+            else:
+                self._expr(base, held)
+        for arg in node.args:
+            self._expr(arg, held)
+        for kw in node.keywords:
+            self._expr(kw.value, held)
+
+    def _thread_create(self, node: ast.Call) -> None:
+        spec = None
+        daemon = False
+        for kw in node.keywords:
+            if kw.arg == "target":
+                t = kw.value
+                if isinstance(t, ast.Name):
+                    spec = ("bare", t.id)
+                elif _self_attr(t):
+                    spec = ("self", _self_attr(t))
+                elif isinstance(t, ast.Attribute):
+                    dotted = dotted_name(t)
+                    if dotted:
+                        spec = ("dotted", dotted)
+                elif isinstance(t, ast.Lambda):
+                    spec = ("lambda", t)
+            elif kw.arg == "daemon":
+                daemon = (
+                    isinstance(kw.value, ast.Constant) and bool(kw.value.value)
+                )
+        if spec is None:
+            return
+        site = ThreadSite(node, spec, id(self.fn), self.fi.relpath)
+        site.daemon = daemon
+        self.thread_sites.append(site)
+        self._last_thread = site
+
+    def _bind_thread(self, target: ast.AST) -> None:
+        site, self._last_thread = self._last_thread, None
+        if isinstance(target, ast.Name):
+            site.bound = ("local", target.id)
+        elif _self_attr(target):
+            site.bound = ("attr", _self_attr(target))
+
+    # -- access recording
+
+    def _attr_access(self, attr: str, node: ast.AST, kind: str,
+                     held: FrozenSet[Token]) -> None:
+        cls = self.cls
+        if cls is None:
+            return
+        if attr in cls.lock_attrs or attr in cls.guarded_attrs \
+                or attr in cls.safe_attrs or attr in cls.methods:
+            return
+        if attr.isupper():
+            return
+        self.accesses.append(Access(
+            ("attr", cls.qual, attr), kind, held, self.fi.relpath,
+            node.lineno, node.col_offset, id(self.fn),
+        ))
+
+    def _global_access(self, node: ast.Name, kind: str,
+                       held: FrozenSet[Token]) -> None:
+        name = node.id
+        if name not in self.facts["mutable_globals"] or name.isupper():
+            return
+        if kind == "read":
+            flow = self.flow()
+            # a reaching local definition means this is not the global
+            if flow.is_use(node) and flow.reaching(node):
+                return
+        elif name not in self._globals_declared:
+            return
+        self.accesses.append(Access(
+            ("global", self.fi.module, name), kind, held, self.fi.relpath,
+            node.lineno, node.col_offset, id(self.fn),
+        ))
+
+    def _store(self, target: ast.AST, stmt: ast.AST,
+               held: FrozenSet[Token]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._store(el, stmt, held)
+            return
+        if isinstance(target, ast.Starred):
+            self._store(target.value, stmt, held)
+            return
+        attr = _self_attr(target)
+        if attr:
+            self._attr_access(attr, stmt, "write", held)
+            return
+        if isinstance(target, ast.Name):
+            self._global_access_store(target, stmt, held)
+            return
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            self._expr(target.slice, held)
+            if _self_attr(base):
+                self._attr_access(_self_attr(base), stmt, "write", held)
+            elif isinstance(base, ast.Name):
+                self._global_access_store(base, stmt, held)
+            else:
+                self._expr(base, held)
+            return
+        if isinstance(target, ast.Attribute):
+            self._expr(target.value, held)
+
+    def _global_access_store(self, name_node: ast.Name, stmt: ast.AST,
+                             held: FrozenSet[Token]) -> None:
+        name = name_node.id
+        if (name in self.facts["mutable_globals"]
+                and name in self._globals_declared and not name.isupper()):
+            self.accesses.append(Access(
+                ("global", self.fi.module, name), "write", held,
+                self.fi.relpath, stmt.lineno, stmt.col_offset, id(self.fn),
+            ))
+
+    def _read_of_target(self, target: ast.AST, held: FrozenSet[Token]) -> None:
+        """AugAssign reads its target before writing it."""
+        attr = _self_attr(target)
+        if attr:
+            self._attr_access(attr, target, "read", held)
+        elif isinstance(target, ast.Name):
+            self._global_access(
+                ast.copy_location(ast.Name(id=target.id, ctx=ast.Load()), target),
+                "read", held)
+
+    # -- lock token resolution
+
+    def _lock_token(self, expr: ast.AST) -> Optional[Token]:
+        attr = _self_attr(expr)
+        if attr:
+            cls = self.cls
+            if cls is None:
+                return None
+            if attr in cls.lock_attrs:
+                return ("attr", cls.qual, attr)
+            # an attribute we could not type (e.g. a lock passed into
+            # __init__): trust it only when the name is lock-shaped
+            if attr not in cls.guarded_attrs and attr not in cls.safe_attrs \
+                    and attr not in cls.methods and _lockish_name(attr):
+                return ("attr", cls.qual, attr)
+            return None
+        if isinstance(expr, ast.Name):
+            flow = self.flow()
+            if flow.is_use(expr):
+                defs = flow.reaching(expr)
+                if defs:
+                    # local alias: `cv = self._cv; with cv:`
+                    toks = set()
+                    for d in defs:
+                        if d.value is not None and _self_attr(d.value):
+                            sub = self._lock_token(d.value)
+                            if sub is not None:
+                                toks.add(sub)
+                    if len(toks) == 1:
+                        return next(iter(toks))
+                    # unresolvable local (a lock parameter, a lock pulled
+                    # from a container): only lock-shaped *names* become
+                    # tokens — `with span:` / `with conn:` are context
+                    # managers, not locks, and must not pad locksets
+                    if _lockish_name(expr.id):
+                        return ("local", str(id(self.fn)), expr.id)
+                    return None
+            if expr.id in self.facts["global_locks"]:
+                return ("global", self.fi.module, expr.id)
+            if _lockish_name(expr.id):
+                return ("local", str(id(self.fn)), expr.id)
+            return None
+        if isinstance(expr, ast.Attribute):
+            # cross-module global lock: `with locks.REGISTRY:` — resolved
+            # through the import map so both sides share one token
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in self.fi.imports:
+                return ("global", self.fi.imports[base.id], expr.attr)
+        return None
+
+    # -- post-pass
+
+    def _fix_daemon_flags(self) -> None:
+        """`t.daemon = True` after construction counts as daemon=True."""
+        bound = {
+            s.bound[1]: s for s in self.thread_sites
+            if s.bound and s.bound[0] == "local"
+        }
+        bound_attr = {
+            s.bound[1]: s for s in self.thread_sites
+            if s.bound and s.bound[0] == "attr"
+        }
+        for node in ast.walk(self.fn):
+            if id(node) in self._nested or not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if not (isinstance(target, ast.Attribute)
+                        and target.attr == "daemon"):
+                    continue
+                truthy = isinstance(node.value, ast.Constant) and bool(node.value.value)
+                if isinstance(target.value, ast.Name) \
+                        and target.value.id in bound and truthy:
+                    bound[target.value.id].daemon = True
+                a = _self_attr(target.value)
+                if a and a in bound_attr and truthy:
+                    bound_attr[a].daemon = True
+
+
+# ------------------------------------------------------------------ per file
+
+def collect_facts(project: Project, fi: FileInfo) -> None:
+    """Pass-1 hook shared by the three checkers (idempotent per file)."""
+    store = project.data.setdefault(FACTS_KEY, {})
+    if fi.relpath in store:
+        return
+    idx = _Index(fi.module)
+    idx.visit(fi.tree)
+    classes = {qual: _class_conc(qual, cls) for qual, cls in idx.classes.items()}
+    mutable_globals: Set[str] = set()
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Global):
+            mutable_globals.update(node.names)
+    global_locks: Set[str] = set()
+    for node in fi.tree.body:
+        if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+                and call_name(node.value) in LOCK_FACTORIES | LOCK_WRAPPERS):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    global_locks.add(target.id)
+    facts = {
+        "fi": fi,
+        "index": idx,
+        "classes": classes,
+        "mutable_globals": mutable_globals,
+        "global_locks": global_locks,
+        "joined_attrs": set(),
+        "joined_names": set(),
+        "scans": {},
+        "thread_sites": [],
+    }
+    for fn in idx.fns:
+        cls = classes.get(idx.self_class.get(id(fn), ""))
+        scan = _FnScan(fi, fn, cls, facts)
+        scan.run()
+        facts["scans"][id(fn)] = scan
+        facts["thread_sites"].extend(scan.thread_sites)
+    store[fi.relpath] = facts
+
+
+# ------------------------------------------------------------- whole program
+
+def _is_test_file(relpath: str) -> bool:
+    return os.path.basename(relpath).startswith("test_")
+
+
+class _Resolver:
+    """Cross-file call/target resolution over the collected facts."""
+
+    def __init__(self, all_facts: Dict[str, dict]):
+        self.all_facts = all_facts
+        self.fn_home: Dict[int, dict] = {}
+        self.toplevel: Dict[str, List[Tuple[str, int]]] = {}
+        for facts in all_facts.values():
+            idx = facts["index"]
+            for fn in idx.fns:
+                self.fn_home[id(fn)] = facts
+                if idx.parents.get(id(fn)) is None and not isinstance(fn, ast.Lambda):
+                    self.toplevel.setdefault(fn.name, []).append(
+                        (facts["fi"].module, id(fn))
+                    )
+
+    def _external(self, target: str) -> List[int]:
+        mod, _, name = target.rpartition(".")
+        return [fid for m, fid in self.toplevel.get(name, ())
+                if _modules_match(mod, m)]
+
+    def resolve(self, facts: dict, caller: int, spec: tuple) -> List[int]:
+        idx, fi = facts["index"], facts["fi"]
+        kind, val = spec
+        if kind == "lambda":
+            return [id(val)]
+        if kind == "self":
+            qual = idx.self_class.get(caller, "")
+            fn = idx.method_of.get((qual, val))
+            if fn is not None:
+                return [id(fn)]
+            return [id(f) for f in idx.by_name.get(val, ())]
+        if kind == "bare":
+            out = [id(f) for f in idx.by_name.get(val, ())]
+            if not out and val in fi.imports:
+                out = self._external(fi.imports[val])
+            return out
+        if kind == "dotted":
+            head, _, rest = val.partition(".")
+            if head in fi.imports and rest:
+                return self._external(fi.imports[head] + "." + rest)
+        return []
+
+    def callees(self, fid: int) -> List[int]:
+        facts = self.fn_home.get(fid)
+        if facts is None:
+            return []
+        scan = facts["scans"].get(fid)
+        if scan is None:
+            return []
+        out: List[int] = []
+        for spec, _held, _node in scan.call_sites:
+            out.extend(self.resolve(facts, fid, spec))
+        return out
+
+    def label(self, fid: int) -> str:
+        facts = self.fn_home.get(fid)
+        if facts is None:
+            return "<unknown>"
+        idx = facts["index"]
+        name = idx.name_of.get(fid, "<fn>")
+        qual = idx.self_class.get(fid, "")
+        if qual:
+            return f"{qual}.{name}"
+        return f"{facts['fi'].module}.{name}"
+
+
+def _close_root(seeds: Iterable[int], barrier: Set[int],
+                resolver: _Resolver, children: Dict[int, List[int]],
+                no_expand: Set[int]) -> Set[int]:
+    out: Set[int] = set(seeds)
+    work = list(out)
+    while work:
+        fid = work.pop()
+        if fid in no_expand:
+            # teardown/startup methods join (or precede) the threads they
+            # manage: the helpers they call are sequenced, not concurrent
+            continue
+        for callee in resolver.callees(fid):
+            if callee in barrier and callee not in out:
+                continue  # another root's entry point
+            if callee not in out:
+                out.add(callee)
+                work.append(callee)
+        for child in children.get(fid, ()):
+            if child in barrier and child not in out:
+                continue
+            if child not in out:
+                out.add(child)
+                work.append(child)
+    return out
+
+
+def _render_token(tok: Token) -> str:
+    kind, scope, name = tok
+    if kind == "attr":
+        cls = scope.rsplit(".", 1)[-1] if scope else scope
+        return f"{cls}.{name}"
+    if kind == "global":
+        return f"{scope}.{name}"
+    return name
+
+
+def _render_lockset(locks: FrozenSet[Token]) -> str:
+    if not locks:
+        return "no lock"
+    return "{" + ", ".join(sorted(_render_token(t) for t in locks)) + "}"
+
+
+def _render_key(key: Token) -> str:
+    kind, scope, name = key
+    if kind == "attr":
+        return f"self.{name} ({scope})"
+    return f"global {name} ({scope})"
+
+
+def build_model(project: Project) -> dict:
+    """Build (once per run) thread roots, per-function entry locksets, and
+    the DK119/DK120/DK121 finding lists grouped by file."""
+    model = project.data.get(MODEL_KEY)
+    if model is not None:
+        return model
+    all_facts: Dict[str, dict] = dict(
+        sorted(project.data.get(FACTS_KEY, {}).items())
+    )
+    resolver = _Resolver(all_facts)
+
+    children: Dict[int, List[int]] = {}
+    for facts in all_facts.values():
+        idx = facts["index"]
+        for fn in idx.fns:
+            parent = idx.parents.get(id(fn))
+            if parent is not None:
+                children.setdefault(parent, []).append(id(fn))
+
+    # ---- thread / handler roots
+    root_seeds: Dict[str, Set[int]] = {}
+    target_of_site: Dict[int, List[int]] = {}
+    for facts in all_facts.values():
+        for site in facts["thread_sites"]:
+            targets = resolver.resolve(facts, site.fn_id, site.spec)
+            target_of_site[id(site)] = targets
+            for t in targets:
+                root_seeds.setdefault(f"thread:{resolver.label(t)}", set()).add(t)
+        for qual, info in sorted(facts["classes"].items()):
+            if info.is_handler:
+                idx = facts["index"]
+                seeds = {
+                    id(fn) for (q, _n), fn in idx.method_of.items() if q == qual
+                }
+                if seeds:
+                    root_seeds[f"handler:{qual}"] = seeds
+
+    barrier: Set[int] = set()
+    for seeds in root_seeds.values():
+        barrier |= seeds
+
+    # descendants of barrier functions never belong to main
+    under_barrier: Set[int] = set(barrier)
+    changed = True
+    while changed:
+        changed = False
+        for parent, kids in children.items():
+            if parent in under_barrier:
+                for k in kids:
+                    if k not in under_barrier:
+                        under_barrier.add(k)
+                        changed = True
+
+    # in-tree call sites, resolved once: used both for main-root seeding
+    # and for the entry-lockset fixpoint below
+    call_sites_of: Dict[int, List[Tuple[int, FrozenSet[Token]]]] = {}
+    for facts in all_facts.values():
+        for fid, scan in facts["scans"].items():
+            for spec, held, _node in scan.call_sites:
+                for callee in resolver.resolve(facts, fid, spec):
+                    call_sites_of.setdefault(callee, []).append((fid, held))
+
+    # main seeds: the externally reachable surface — public names (callable
+    # by API consumers at any time) and anything no in-tree code calls.
+    # Private helpers with in-tree callers join main only through the
+    # closure, so a `_reset` helper called solely by a daemon loop stays
+    # exclusive to that loop's root instead of self-racing via main.
+    main_seeds: Set[int] = set()
+    for facts in all_facts.values():
+        idx = facts["index"]
+        for fn in idx.fns:
+            fid = id(fn)
+            if fid in under_barrier:
+                continue
+            name = idx.name_of.get(fid, "")
+            if not name.startswith("_") or fid not in call_sites_of:
+                main_seeds.add(fid)
+
+    no_expand: Set[int] = set()
+    for facts in all_facts.values():
+        idx = facts["index"]
+        for fn in idx.fns:
+            if idx.name_of.get(id(fn), "") in EXEMPT_METHODS:
+                no_expand.add(id(fn))
+
+    roots: Dict[str, Set[int]] = {
+        name: _close_root(seeds, barrier, resolver, children, no_expand)
+        for name, seeds in sorted(root_seeds.items())
+    }
+    roots["main"] = _close_root(main_seeds, barrier, resolver, children,
+                                no_expand)
+
+    fn_roots: Dict[int, Set[str]] = {}
+    for name, members in roots.items():
+        for fid in members:
+            fn_roots.setdefault(fid, set()).add(name)
+
+    # ---- entry locksets: intersection over resolved call sites
+    entry: Dict[int, Optional[FrozenSet[Token]]] = {}
+    for facts in all_facts.values():
+        for fn in facts["index"].fns:
+            fid = id(fn)
+            if fid in barrier or fid not in call_sites_of:
+                entry[fid] = frozenset()
+            else:
+                entry[fid] = None  # ⊤ until a grounded caller is seen
+    changed = True
+    while changed:
+        changed = False
+        for fid, sites in call_sites_of.items():
+            if fid in barrier:
+                continue
+            vals = [
+                held | entry[caller]
+                for caller, held in sites
+                if entry.get(caller) is not None
+            ]
+            new: Optional[FrozenSet[Token]]
+            if vals:
+                new = frozenset.intersection(*vals)
+            else:
+                new = None
+            if new != entry.get(fid):
+                entry[fid] = new
+                changed = True
+    entry_of = {fid: (e if e is not None else frozenset())
+                for fid, e in entry.items()}
+
+    by_file: Dict[str, Dict[str, List[Finding]]] = {}
+
+    def emit(relpath: str, rule: str, finding: Finding) -> None:
+        if _is_test_file(relpath):
+            return
+        by_file.setdefault(relpath, {}).setdefault(rule, []).append(finding)
+
+    _dk119(all_facts, fn_roots, entry_of, emit)
+    _dk120(all_facts, resolver, entry_of, emit)
+    _dk121(all_facts, resolver, target_of_site, emit)
+
+    model = {
+        "roots": roots,
+        "fn_roots": fn_roots,
+        "entry": entry_of,
+        "by_file": by_file,
+    }
+    project.data[MODEL_KEY] = model
+    return model
+
+
+def findings_for(project: Project, fi: FileInfo, rule: str) -> List[Finding]:
+    return build_model(project)["by_file"].get(fi.relpath, {}).get(rule, [])
+
+
+# ---------------------------------------------------------------------- DK119
+
+def _dk119(all_facts: Dict[str, dict], fn_roots: Dict[int, Set[str]],
+           entry_of: Dict[int, FrozenSet[Token]], emit) -> None:
+    by_key: Dict[Token, List[Access]] = {}
+    for facts in all_facts.values():
+        idx = facts["index"]
+        for fid, scan in sorted(facts["scans"].items(),
+                                key=lambda kv: kv[1].fn.lineno
+                                if hasattr(kv[1].fn, "lineno") else 0):
+            roots = fn_roots.get(fid)
+            if not roots:
+                continue
+            if idx.name_of.get(fid, "") in EXEMPT_METHODS:
+                continue
+            for acc in scan.accesses:
+                acc.lockset = acc.lockset | entry_of.get(fid, frozenset())
+                acc.roots = frozenset(roots)
+                by_key.setdefault(acc.key, []).append(acc)
+
+    for key in sorted(by_key):
+        accs = sorted(by_key[key], key=lambda a: (a.relpath, a.line, a.col))
+        all_roots: Set[str] = set()
+        for a in accs:
+            all_roots |= a.roots
+        if len(all_roots) < 2:
+            continue
+        if not any(a.kind == "write" for a in accs):
+            continue
+        seen_sites: Set[Tuple[str, int, int]] = set()
+        for a in accs:
+            counterpart = _race_counterpart(a, accs)
+            if counterpart is None:
+                continue
+            site = (a.relpath, a.line, a.col)
+            if site in seen_sites:
+                continue
+            seen_sites.add(site)
+            b = counterpart
+            other_root = sorted(r for r in b.roots if r != "main") \
+                or sorted(b.roots)
+            emit(a.relpath, "DK119", Finding(
+                path=a.relpath, line=a.line, col=a.col, rule="DK119",
+                message=(
+                    f"shared-state race on {_render_key(key)}: "
+                    f"{a.kind} holding {_render_lockset(a.lockset)} races "
+                    f"with the {b.kind} at {b.relpath}:{b.line} on "
+                    f"'{other_root[0]}' holding {_render_lockset(b.lockset)} "
+                    "(no common lock)"
+                ),
+            ))
+
+
+def _race_counterpart(a: Access, accs: List[Access]) -> Optional[Access]:
+    for b in accs:
+        if b is a:
+            # one site reachable from >=2 roots races with itself when
+            # nothing guards it
+            if len(a.roots) >= 2 and a.kind == "write" and not a.lockset:
+                return a
+            continue
+        cross = bool((a.roots | b.roots) - a.roots) or bool(a.roots - b.roots) \
+            or (len(a.roots) >= 2 and a.roots == b.roots and len(a.roots) >= 2)
+        if not cross and a.roots == b.roots and len(a.roots) < 2:
+            continue
+        if not (a.roots != b.roots or len(a.roots) >= 2):
+            continue
+        if a.kind != "write" and b.kind != "write":
+            continue
+        if a.lockset & b.lockset:
+            continue
+        if a.kind == "write" and len(a.lockset) <= len(b.lockset):
+            return b
+        if a.kind == "read" and not a.lockset and b.kind == "write" \
+                and b.lockset:
+            return b
+    return None
+
+
+# ---------------------------------------------------------------------- DK120
+
+def _dk120(all_facts: Dict[str, dict], resolver: _Resolver,
+           entry_of: Dict[int, FrozenSet[Token]], emit) -> None:
+    # transitive acquisitions per function
+    acq_local: Dict[int, Set[Token]] = {}
+    for facts in all_facts.values():
+        for fid, scan in facts["scans"].items():
+            acq_local[fid] = {
+                tok for tok, _held, _node in scan.acquisitions
+                if tok[0] != "local"
+            }
+    acq_star: Dict[int, Set[Token]] = {f: set(s) for f, s in acq_local.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid in acq_star:
+            for callee in resolver.callees(fid):
+                extra = acq_star.get(callee, set()) - acq_star[fid]
+                if extra:
+                    acq_star[fid] |= extra
+                    changed = True
+
+    # ordered edges A -> B: B acquired (directly or via a call) holding A
+    edges: Dict[Tuple[Token, Token], Tuple[str, int, int, str]] = {}
+
+    def add_edge(a: Token, b: Token, relpath: str, node: ast.AST,
+                 via: str) -> None:
+        if a == b or a[0] == "local" or b[0] == "local":
+            return
+        key = (a, b)
+        site = (relpath, node.lineno, node.col_offset, via)
+        if key not in edges or site[:2] < edges[key][:2]:
+            edges[key] = site
+
+    for facts in all_facts.values():
+        relpath = facts["fi"].relpath
+        for fid, scan in facts["scans"].items():
+            for tok, held, node in scan.acquisitions:
+                for h in held:
+                    add_edge(h, tok, relpath, node, "directly")
+            for spec, held, node in scan.call_sites:
+                if not held:
+                    continue
+                for callee in resolver.resolve(facts, fid, spec):
+                    for tok in acq_star.get(callee, ()):
+                        for h in held:
+                            add_edge(
+                                h, tok, relpath, node,
+                                f"via {resolver.label(callee)}()",
+                            )
+
+    adj: Dict[Token, Set[Token]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+
+    def reaches(src: Token, dst: Token) -> bool:
+        seen = {src}
+        work = [src]
+        while work:
+            cur = work.pop()
+            for nxt in adj.get(cur, ()):
+                if nxt == dst:
+                    return True
+                if nxt not in seen:
+                    seen.add(nxt)
+                    work.append(nxt)
+        return False
+
+    for (a, b), (relpath, line, col, via) in sorted(
+            edges.items(), key=lambda kv: (kv[1][:3], kv[0])):
+        if reaches(b, a):
+            emit(relpath, "DK120", Finding(
+                path=relpath, line=line, col=col, rule="DK120",
+                message=(
+                    f"lock-order inversion: {_render_token(b)} acquired "
+                    f"{via} while holding {_render_token(a)}, but elsewhere "
+                    f"{_render_token(a)} is acquired while "
+                    f"{_render_token(b)} is held — deadlock-prone cycle"
+                ),
+            ))
+
+
+# ---------------------------------------------------------------------- DK121
+
+_SAFE_LOOP_STMTS = (ast.Pass, ast.Break, ast.Continue, ast.Return)
+
+
+def _dk121(all_facts: Dict[str, dict], resolver: _Resolver,
+           target_of_site: Dict[int, List[int]], emit) -> None:
+    flagged_loops: Set[int] = set()
+    for facts in all_facts.values():
+        relpath = facts["fi"].relpath
+        for site in facts["thread_sites"]:
+            # leg A: a non-daemon thread nobody joins outlives shutdown
+            if not site.daemon and not _is_joined(site, facts):
+                label = _site_label(site, resolver, target_of_site)
+                emit(relpath, "DK121", Finding(
+                    path=relpath, line=site.node.lineno,
+                    col=site.node.col_offset, rule="DK121",
+                    message=(
+                        f"thread-lifecycle: non-daemon thread '{label}' is "
+                        "never joined or stopped on any shutdown path "
+                        "(set daemon=True or join it in close/stop)"
+                    ),
+                ))
+            # leg B: runner-loop body without exception containment
+            for target in target_of_site.get(id(site), ()):
+                home = resolver.fn_home.get(target)
+                if home is None:
+                    continue
+                fn = next(
+                    (f for f in home["index"].fns if id(f) == target), None
+                )
+                if fn is None or isinstance(fn, ast.Lambda):
+                    continue
+                for stmt in fn.body:
+                    if not isinstance(stmt, ast.While):
+                        continue
+                    if id(stmt) in flagged_loops:
+                        continue
+                    if _loop_contained(stmt):
+                        continue
+                    flagged_loops.add(id(stmt))
+                    emit(home["fi"].relpath, "DK121", Finding(
+                        path=home["fi"].relpath, line=stmt.lineno,
+                        col=stmt.col_offset, rule="DK121",
+                        message=(
+                            "thread-lifecycle: runner loop of thread target "
+                            f"'{resolver.label(target)}' has statements "
+                            "outside try/except — one exception kills the "
+                            "thread silently"
+                        ),
+                    ))
+
+
+def _is_joined(site: ThreadSite, facts: dict) -> bool:
+    if site.bound is None:
+        return False
+    kind, name = site.bound
+    if kind == "attr":
+        return name in facts["joined_attrs"]
+    return name in facts["joined_names"]
+
+
+def _site_label(site: ThreadSite, resolver: _Resolver,
+                target_of_site: Dict[int, List[int]]) -> str:
+    targets = target_of_site.get(id(site), ())
+    if targets:
+        return resolver.label(targets[0])
+    kind, val = site.spec
+    return val if isinstance(val, str) else "<lambda>"
+
+
+def _loop_contained(loop: ast.While) -> bool:
+    """Every effectful statement of the loop body sits inside a
+    ``try`` with at least one handler."""
+    for stmt in loop.body:
+        if isinstance(stmt, ast.Try) and stmt.handlers:
+            continue
+        if isinstance(stmt, _SAFE_LOOP_STMTS):
+            continue
+        return False
+    return True

@@ -322,8 +322,14 @@ def analyze(
     paths: Sequence[str],
     root: Optional[str] = None,
     select: Optional[Sequence[str]] = None,
+    jobs: Optional[int] = None,
 ) -> Tuple[List[Finding], Dict[str, FileInfo]]:
     """Run all (or ``select``-ed) checkers over ``paths``.
+
+    ``jobs`` > 1 fans the per-file *check* pass out over worker processes;
+    every worker still runs the project-wide collect pass (whole-program
+    facts must be complete in each), so results are byte-identical to a
+    sequential run.  Falls back to sequential when a pool can't start.
 
     Returns suppression-filtered findings (baseline not yet applied) plus
     the relpath -> FileInfo map the caller needs for fingerprinting."""
@@ -331,12 +337,18 @@ def analyze(
 
     root = os.path.abspath(root or os.getcwd())
     files = [load_file(os.path.abspath(p), root) for p in discover(paths)]
+    if jobs and jobs > 1 and len(files) > 1:
+        findings = _analyze_parallel(list(paths), root, select, jobs,
+                                     [fi.relpath for fi in files])
+        if findings is not None:
+            findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+            return findings, {fi.relpath: fi for fi in files}
     project = Project(root, files)
     checkers = get_checkers(select)
     for checker in checkers:
         for fi in files:
             checker.collect(project, fi)
-    findings: List[Finding] = []
+    findings = []
     for checker in checkers:
         for fi in files:
             for f in checker.check(project, fi):
@@ -344,6 +356,63 @@ def analyze(
                     findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, {fi.relpath: fi for fi in files}
+
+
+def _check_chunk(
+    paths: Sequence[str],
+    root: str,
+    select: Optional[Sequence[str]],
+    chunk: Sequence[str],
+) -> List[Finding]:
+    """Worker body for ``analyze(jobs=N)``: full project collect, then the
+    check pass restricted to the ``chunk`` relpaths."""
+    from tools.dklint.registry import get_checkers
+
+    files = [load_file(os.path.abspath(p), root) for p in discover(paths)]
+    project = Project(root, files)
+    checkers = get_checkers(select)
+    for checker in checkers:
+        for fi in files:
+            checker.collect(project, fi)
+    wanted = set(chunk)
+    findings: List[Finding] = []
+    for checker in checkers:
+        for fi in files:
+            if fi.relpath not in wanted:
+                continue
+            for f in checker.check(project, fi):
+                if not is_suppressed(fi, f):
+                    findings.append(f)
+    return findings
+
+
+def _analyze_parallel(
+    paths: Sequence[str],
+    root: str,
+    select: Optional[Sequence[str]],
+    jobs: int,
+    relpaths: Sequence[str],
+) -> Optional[List[Finding]]:
+    """Fan ``_check_chunk`` out over a process pool; ``None`` means the
+    pool could not run (restricted environment) — caller goes sequential."""
+    import concurrent.futures as _cf
+
+    jobs = max(1, min(int(jobs), len(relpaths)))
+    chunks = [list(relpaths[i::jobs]) for i in range(jobs)]
+    sel = list(select) if select else None
+    try:
+        with _cf.ProcessPoolExecutor(max_workers=jobs) as pool:
+            futures = [
+                pool.submit(_check_chunk, list(paths), root, sel, chunk)
+                for chunk in chunks if chunk
+            ]
+            findings: List[Finding] = []
+            for fut in futures:
+                findings.extend(fut.result())
+            return findings
+    except (OSError, PermissionError, _cf.process.BrokenProcessPool,
+            ImportError):
+        return None
 
 
 # ------------------------------------------------------------------ AST utils
