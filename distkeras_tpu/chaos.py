@@ -35,6 +35,9 @@ makes recovery paths provable in CI rather than asserted.
 ``delay_commit_ms=M``   every ``ckpt_commit`` site sleeps M milliseconds
                         first — widens the committed-but-unpublished window
                         a cross-process watcher must never surface
+``kill_rotate=N``       raise :class:`ChaosKilled` at the Nth ``window_rotate``
+                        site — after a capture window's shard files landed
+                        but *before* its manifest published (fires once)
 ``torn_ckpt=N``         truncate one seeded leaf file of the Nth *published*
                         checkpoint (post-commit torn write / lost page
                         cache; fires once)
@@ -79,6 +82,7 @@ _INT_KEYS = frozenset({
     "drop_reply", "drop_recv", "tear_send", "delay_send_ms",
     "kill_replica", "stall_http",
     "kill_commit", "delay_commit_ms", "torn_ckpt", "flip_ckpt",
+    "kill_rotate",
 })
 _FLOAT_KEYS = frozenset({"stall_secs"})
 
@@ -202,7 +206,8 @@ def _note(kind: str) -> None:
 def fault(site: str) -> None:
     """Fire any armed fault for ``site``; no-op (beyond one counter bump)
     otherwise.  Sites: ``connect``, ``send``, ``recv``, ``rpc_reply``,
-    ``epoch``, ``block``, ``replica``, ``http``, ``ckpt_commit``."""
+    ``epoch``, ``block``, ``replica``, ``http``, ``ckpt_commit``,
+    ``window_rotate``."""
     cfg = spec()
     if cfg is None:
         return
@@ -253,6 +258,13 @@ def fault(site: str) -> None:
         if k is not None and n < k:
             _note("stall_http")
             time.sleep(cfg.get("stall_secs") or 0.05)  # dklint: disable=DK112 — injected stall
+    elif site == "window_rotate":
+        k = cfg.get("kill_rotate")
+        if k is not None and n == k and _fire_once("kill_rotate"):
+            _note("kill_rotate")
+            raise ChaosKilled(
+                f"chaos: capture killed between shard rotation and manifest "
+                f"publish (window rotation {n})")
     elif site == "ckpt_commit":
         delay = cfg.get("delay_commit_ms")
         if delay:

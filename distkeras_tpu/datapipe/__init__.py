@@ -24,7 +24,13 @@ subsystem of its own, without changing a single trained bit:
 
 from distkeras_tpu.datapipe.packing import PackedBatch, pack_sequences
 from distkeras_tpu.datapipe.ring import PrefetchRing
-from distkeras_tpu.datapipe.source import ArraySource, MemmapSource, Source, host_shard
+from distkeras_tpu.datapipe.source import (
+    ArraySource,
+    MemmapSource,
+    Source,
+    atomic_write_npy,
+    host_shard,
+)
 from distkeras_tpu.datapipe.state import DataState
 
 __all__ = [
@@ -34,6 +40,7 @@ __all__ = [
     "PackedBatch",
     "PrefetchRing",
     "Source",
+    "atomic_write_npy",
     "host_shard",
     "pack_sequences",
 ]

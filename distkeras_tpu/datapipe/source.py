@@ -23,11 +23,47 @@ default (overridable for tests and non-JAX tooling).
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["Source", "ArraySource", "MemmapSource", "host_shard"]
+__all__ = ["Source", "ArraySource", "MemmapSource", "atomic_write_npy",
+           "host_shard"]
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a directory entry durable (the rename itself, not just the
+    renamed bytes).  Best-effort: not every filesystem lets you open or
+    fsync a directory."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_npy(path: str, array: np.ndarray) -> str:
+    """Publish one ``.npy`` shard atomically: tmp + fsync + ``os.replace`` +
+    parent-dir fsync, the same discipline as checkpoint manifests (DK118).
+    A cross-process reader — a :class:`MemmapSource` built by a window
+    scheduler polling the shard directory — sees the old file or the new
+    file, never a torn header or a half-written row, and the new bytes
+    survive power loss once this returns.  Returns ``path``."""
+    array = np.ascontiguousarray(array)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.save(fh, array)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    return path
 
 
 def _process_slot(process_index: Optional[int], process_count: Optional[int]):

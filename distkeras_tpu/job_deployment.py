@@ -112,6 +112,12 @@ class PunchcardServer:
         # job_ids, respawns, max_respawns}.  Mutated under the cv; the
         # runner loop's idle wakeups double as the respawn supervisor.
         self._tiers: Dict[str, dict] = {}
+        # online serve->train deployments: online_id -> {tier_id,
+        # trainer_job_id, capture_dir, checkpoint_dir, placement}.  The
+        # serving replicas live in self._tiers (so the respawn supervisor
+        # covers them); this record ties them to their trainer job and the
+        # capture/checkpoint directories the loop pivots on.
+        self._online: Dict[str, dict] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -302,6 +308,145 @@ class PunchcardServer:
                                   if self._stop_serving_job(jid))
                     send_data(conn, {"status": "stopped",
                                      "tier_id": msg.get("tier_id"),
+                                     "stopped": stopped})
+            elif action == "online_loop":
+                # Co-schedule the whole serve->train loop on this fleet:
+                # ``replicas`` serving jobs as one supervised tier (their
+                # script installs a TrafficLog-backed /generate) plus one
+                # detached trainer job (its script runs a WindowScheduler
+                # over the shared capture directory and publishes verified
+                # checkpoint steps the replicas' watcher hot-swaps in).
+                # Placement is decided from the live leases up front and
+                # recorded on the deployment so online_status can show
+                # where the work was put.
+                with self._cv:
+                    cached = self._idempotent.get(idem) if idem else None
+                if cached is not None:
+                    send_data(conn, cached)
+                    return
+                from distkeras_tpu.online.scheduler import plan_placement
+                replicas = max(1, int(msg.get("replicas") or 1))
+                flags = msg.get("flags")
+                flags = dict(flags) if isinstance(flags, dict) else {}
+                online_id = uuid.uuid4().hex
+                capture_dir = (msg.get("capture_dir")
+                               or os.path.join(self.workdir, "online",
+                                               online_id, "capture"))
+                ckpt_dir = (msg.get("checkpoint_dir")
+                            or os.path.join(self.workdir, "online",
+                                            online_id, "ckpt"))
+                os.makedirs(capture_dir, exist_ok=True)
+                os.makedirs(ckpt_dir, exist_ok=True)
+                with self._cv:
+                    self.fleet.sweep()
+                    members = self.fleet.snapshot()["members"]
+                placement = plan_placement(members, replicas)
+                loop_env = {"DISTKERAS_ONLINE_ID": online_id,
+                            "DISTKERAS_CAPTURE_DIR": capture_dir,
+                            "DISTKERAS_CKPT_DIR": ckpt_dir}
+                tier_id = uuid.uuid4().hex
+                job_ids = [
+                    self._spawn_serve_job(
+                        msg["script"], list(msg.get("args", [])), flags,
+                        extra_env={**loop_env,
+                                   "DISTKERAS_TIER_ID": tier_id,
+                                   "DISTKERAS_REPLICA_INDEX": str(i)})
+                    for i in range(replicas)
+                ]
+                trainer_job = self._spawn_serve_job(
+                    msg["trainer_script"],
+                    list(msg.get("trainer_args", [])), flags,
+                    extra_env={**loop_env, "DISTKERAS_ONLINE_ROLE": "trainer"})
+                reply = {"status": "online", "online_id": online_id,
+                         "tier_id": tier_id, "job_ids": list(job_ids),
+                         "trainer_job_id": trainer_job,
+                         "capture_dir": capture_dir,
+                         "checkpoint_dir": ckpt_dir,
+                         "placement": placement}
+                with self._cv:
+                    self._tiers[tier_id] = {
+                        "script": msg["script"],
+                        "args": list(msg.get("args", [])),
+                        "flags": flags,
+                        "job_ids": job_ids,
+                        "respawns": 0,
+                        "max_respawns": int(msg.get("max_respawns", 3)),
+                    }
+                    self._online[online_id] = {
+                        "tier_id": tier_id,
+                        "trainer_job_id": trainer_job,
+                        "capture_dir": capture_dir,
+                        "checkpoint_dir": ckpt_dir,
+                        "placement": placement,
+                    }
+                    self._remember(idem, reply)
+                send_data(conn, reply)
+            elif action == "online_status":
+                with self._cv:
+                    ent = self._online.get(msg.get("online_id", ""))
+                    ent = dict(ent) if ent else None
+                    tier = self._tiers.get(ent["tier_id"]) if ent else None
+                    job_ids = list(tier["job_ids"]) if tier else []
+                if ent is None:
+                    send_data(conn, {"status": "unknown"})
+                else:
+                    reps = []
+                    for jid in job_ids:
+                        job = self.jobs.get(jid)
+                        if job is None:
+                            continue
+                        self._refresh_serving(jid, job)
+                        reps.append({"job_id": jid,
+                                     "status": job["status"],
+                                     "http": self._job_http_address(job)})
+                    tjid = ent["trainer_job_id"]
+                    tjob = self.jobs.get(tjid)
+                    if tjob is not None:
+                        self._refresh_serving(tjid, tjob)
+                    # window/step progress straight off the filesystem —
+                    # counting manifests keeps the daemon free of the
+                    # accelerator-heavy checkpoint module
+                    from distkeras_tpu.online.capture import published_windows
+                    windows = len(published_windows(ent["capture_dir"]))
+                    steps = 0
+                    if os.path.isdir(ent["checkpoint_dir"]):
+                        names = set(os.listdir(ent["checkpoint_dir"]))
+                        steps = sum(
+                            1 for d in names
+                            if d.startswith("step_")
+                            and d.endswith(".manifest.json")
+                            and d[len("step_"):-len(".manifest.json")].isdigit()
+                            and d[:-len(".manifest.json")] in names)
+                    send_data(conn, {
+                        "status": "ok",
+                        "online_id": msg.get("online_id"),
+                        "tier_id": ent["tier_id"],
+                        "replicas": reps,
+                        "serving": sum(1 for r in reps
+                                       if r["status"] == "serving"),
+                        "trainer": {"job_id": tjid,
+                                    "status": (tjob["status"]
+                                               if tjob else "unknown")},
+                        "windows_published": windows,
+                        "steps_published": steps,
+                        "capture_dir": ent["capture_dir"],
+                        "checkpoint_dir": ent["checkpoint_dir"],
+                        "placement": ent["placement"]})
+            elif action == "stop_online":
+                with self._cv:
+                    ent = self._online.pop(msg.get("online_id", ""), None)
+                    tier = (self._tiers.pop(ent["tier_id"], None)
+                            if ent else None)
+                    job_ids = list(tier["job_ids"]) if tier else []
+                if ent is None:
+                    send_data(conn, {"status": "unknown"})
+                else:
+                    stopped = sum(1 for jid in job_ids
+                                  if self._stop_serving_job(jid))
+                    if self._stop_serving_job(ent["trainer_job_id"]):
+                        stopped += 1
+                    send_data(conn, {"status": "stopped",
+                                     "online_id": msg.get("online_id"),
                                      "stopped": stopped})
             elif action == "stop_serving":
                 job_id = msg.get("job_id", "")
@@ -788,6 +933,7 @@ class Job:
         self.args = args or []
         self.job_id: Optional[str] = None
         self.tier_id: Optional[str] = None
+        self.online_id: Optional[str] = None
         #: socket deadline per RPC attempt (connect + send + recv)
         self.rpc_timeout = rpc_timeout
         #: transport-failure retries per RPC (0 = single attempt)
@@ -933,6 +1079,64 @@ class Job:
         if tid is None:
             raise RuntimeError("no tier to stop")
         return self._rpc({"action": "stop_tier", "tier_id": tid})
+
+    def online_loop(self, replicas: int, trainer_script: str,
+                    trainer_args: Optional[list] = None,
+                    flags: Optional[dict] = None,
+                    capture_dir: Optional[str] = None,
+                    checkpoint_dir: Optional[str] = None,
+                    max_respawns: int = 3) -> str:
+        """Deploy the whole serve->train loop on the daemon's fleet
+        (``online_loop`` verb): this client's script as ``replicas``
+        supervised serving jobs plus ``trainer_script`` as the co-scheduled
+        retraining job, wired together through a shared capture directory
+        and checkpoint directory (daemon-chosen under its workdir unless
+        given).  Every spawned process sees ``DISTKERAS_ONLINE_ID`` /
+        ``DISTKERAS_CAPTURE_DIR`` / ``DISTKERAS_CKPT_DIR`` in its
+        environment; the serve script should install its ``/generate``
+        endpoint with a :class:`~distkeras_tpu.online.TrafficLog` on the
+        capture dir and watch the checkpoint dir for hot-swaps, the trainer
+        script should run a :class:`~distkeras_tpu.online.WindowScheduler`
+        over the same pair.  Returns the online id (also stored on
+        ``self.online_id``; the tier id lands on ``self.tier_id``)."""
+        msg: dict = {"action": "online_loop", "script": self.script,
+                     "args": self.args, "replicas": int(replicas),
+                     "trainer_script": trainer_script,
+                     "trainer_args": list(trainer_args or []),
+                     "max_respawns": int(max_respawns),
+                     "idempotency": uuid.uuid4().hex}
+        if flags is not None:
+            msg["flags"] = dict(flags)
+        if capture_dir is not None:
+            msg["capture_dir"] = capture_dir
+        if checkpoint_dir is not None:
+            msg["checkpoint_dir"] = checkpoint_dir
+        reply = self._rpc(msg)
+        if reply.get("status") != "online":
+            raise RuntimeError(f"online_loop rejected: {reply}")
+        self.online_id = reply["online_id"]
+        self.tier_id = reply["tier_id"]
+        return self.online_id
+
+    def online_status(self, online_id: Optional[str] = None) -> dict:
+        """Progress view of an online deployment (``online_status`` verb):
+        serving replica statuses, trainer job status, and the loop's window
+        and checkpoint-step counts read straight off the shared
+        directories — ``{"status": "ok", "replicas": [...], "serving": N,
+        "trainer": {"job_id", "status"}, "windows_published": n,
+        "steps_published": m, "placement": {...}, ...}``."""
+        oid = online_id or self.online_id
+        if oid is None:
+            raise RuntimeError("no online deployment to query")
+        return self._rpc({"action": "online_status", "online_id": oid})
+
+    def stop_online(self, online_id: Optional[str] = None) -> dict:
+        """Tear down an online deployment — every serving replica plus the
+        trainer job (``stop_online`` verb); defaults to this client's."""
+        oid = online_id or self.online_id
+        if oid is None:
+            raise RuntimeError("no online deployment to stop")
+        return self._rpc({"action": "stop_online", "online_id": oid})
 
     def tier_addresses(self, timeout: float = 30.0,
                        poll: float = 0.2) -> list:

@@ -55,7 +55,10 @@ class GenerateRequest:
     engine (minted at the first hop that sees the request, carried over the
     HTTP hop in the body and as ``X-DK-Trace-Id``); ``request_id`` stays
     the idempotency key.  Both ride trace-span args, never metric labels
-    (dklint DK117).
+    (dklint DK117).  ``tenant`` names the client on whose behalf the
+    request runs — the accounting key the online capture layer's per-tenant
+    window quotas meter on (:mod:`distkeras_tpu.online`); empty means
+    untagged (all untagged traffic shares one quota bucket).
     """
 
     prompt: List[int]
@@ -69,6 +72,7 @@ class GenerateRequest:
     speculative: Optional[bool] = None
     timeout_s: Optional[float] = None
     trace_id: str = ""
+    tenant: str = ""
 
     def validate(self) -> None:
         if not self.prompt:
@@ -215,18 +219,22 @@ def _parse_request(request: dict) -> GenerateRequest:
         timeout_s=(None if payload.get("timeout_s") in (None, "", "None")
                    else float(payload["timeout_s"])),
         trace_id=str(payload.get("trace_id", "")),
+        tenant=str(payload.get("tenant", "")),
     )
     headers = request.get("headers") or {}
     if not req.request_id:
         req.request_id = str(headers.get("x-dk-request-id", ""))
     if not req.trace_id:
         req.trace_id = str(headers.get("x-dk-trace-id", ""))
+    if not req.tenant:
+        req.tenant = str(headers.get("x-dk-tenant", ""))
     req.validate()
     return req
 
 
 def install_http_endpoint(engine, path: str = "/generate",
-                          timeout: Optional[float] = None) -> str:
+                          timeout: Optional[float] = None,
+                          traffic_log=None) -> str:
     """Mount a ``/generate`` endpoint for ``engine`` on the flightdeck
     exporter.  Blocking request/response: the handler thread (flightdeck's
     ``ThreadingHTTPServer`` runs one per connection) submits and waits for
@@ -243,7 +251,14 @@ def install_http_endpoint(engine, path: str = "/generate",
     router hop) gets fresh ids here, and the whole handler runs inside a
     ``serving.http_request`` span bound to them — when the router sent the
     request, ``X-DK-Parent-Span`` names the router-side span this one
-    logically nests under, stitching the cross-process trace."""
+    logically nests under, stitching the cross-process trace.
+
+    ``traffic_log`` (a :class:`distkeras_tpu.online.TrafficLog`) closes the
+    serve→train loop: every *successful* generation is offered back to the
+    capture ring after its 200 is decided (sampling/quota admission happens
+    inside the log).  Capture is strictly best-effort here — a capture
+    fault is counted (``online_capture_errors_total``) and swallowed, never
+    surfaced to the client; serving must not fail because capture did."""
     import uuid as _uuid
 
     from distkeras_tpu.telemetry.flightdeck import server as _server
@@ -284,6 +299,16 @@ def install_http_endpoint(engine, path: str = "/generate",
                 # retryable server condition, not a successful generation
                 return ("application/json", result.to_json(), 503,
                         {"Retry-After": "1"})
+            if traffic_log is not None:
+                try:
+                    traffic_log.record(req, result)
+                except Exception:  # noqa: BLE001 — capture is best-effort
+                    from distkeras_tpu import telemetry
+
+                    if telemetry.enabled():
+                        from distkeras_tpu.online.capture import online_metrics
+
+                        online_metrics()["capture_errors"].inc()
             return ("application/json", result.to_json(), 200)
 
     _server.add_endpoint(path, handle)

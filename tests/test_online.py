@@ -1,0 +1,582 @@
+"""Online-loop tests: capture admission (deterministic sampling, content
+filter, per-tenant window quotas), atomic window publication readable back
+through ``MemmapSource``, the journal/sidecar crash-resume protocol — a
+capture killed between shard rotation and manifest publish must resume
+**bitwise**, losing and duplicating nothing (the satellite-3 property) —
+the ``WindowScheduler``'s window→verified-checkpoint pipeline with chaos
+retries, capacity-aware trainer/replica placement, the daemon's
+``online_loop``/``online_status``/``stop_online`` verbs, the frontend
+capture hook, and the ``online_*`` metric schema pinned as golden
+Prometheus text."""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import chaos, telemetry
+from distkeras_tpu.datapipe.source import atomic_write_npy
+from distkeras_tpu.datapipe.state import DataState
+from distkeras_tpu.job_deployment import Job, PunchcardServer
+from distkeras_tpu.online import (
+    SamplingPolicy,
+    TrafficLog,
+    WindowScheduler,
+    load_window_manifest,
+    online_metrics,
+    plan_placement,
+    published_windows,
+    verify_window,
+    window_source,
+)
+from distkeras_tpu.serving import GenerateRequest, GenerateResult
+from distkeras_tpu.telemetry.metrics import Registry
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+@pytest.fixture(autouse=True)
+def clean_online():
+    chaos.configure("")  # chaos off, counters clear, for every test
+    yield
+    chaos.configure(None)
+    telemetry.configure(None)
+
+
+def _gen(i, tenant=""):
+    """One deterministic served generation (request, result) pair."""
+    req = GenerateRequest(prompt=[1 + i, 2, 3 + (i % 4)], tenant=tenant)
+    res = GenerateResult(request_id=f"r{i}", prompt=req.prompt,
+                         tokens=[5, 6 + (i % 3)], finish_reason="length")
+    return req, res
+
+
+def _capture_digest(directory):
+    """sha256 of every published artifact (shards, manifests, sidecar) —
+    journals excluded: they are working state, not publication."""
+    out = {}
+    for name in sorted(os.listdir(directory)):
+        if name.startswith("journal_"):
+            continue
+        with open(os.path.join(directory, name), "rb") as fh:
+            out[name] = hashlib.sha256(fh.read()).hexdigest()
+    return out
+
+
+# ------------------------------------------------------------ metric schema
+
+
+def test_online_metrics_schema_golden():
+    registry = Registry()
+    m = online_metrics(registry)
+    m["ingested"].inc(5)
+    m["dropped"].inc(3)
+    m["quota_drops"].inc(2)
+    m["capture_errors"].inc(1)
+    m["windows_published"].inc(2)
+    m["windows_trained"].inc(2)
+    m["retrain_failures"].inc(1)
+    m["window_lag_seconds"].set(1.5)
+    m["swap_age_seconds"].set(2.5)
+    m["retrain_seconds"].observe(0.5)
+    golden = open(os.path.join(GOLDEN, "online_metrics.txt")).read()
+    assert registry.to_prometheus(labels={"run_id": "fleet1234"}) == golden
+    # get-or-create: a second call must hand back the same instruments
+    assert online_metrics(registry)["ingested"] is m["ingested"]
+
+
+# -------------------------------------------------------- sampling policy
+
+
+def test_sampling_policy_deterministic_across_instances():
+    a = SamplingPolicy(rate=0.5, seed=11)
+    b = SamplingPolicy(rate=0.5, seed=11)
+    decisions = [a._keep(seq) for seq in range(200)]
+    assert decisions == [b._keep(seq) for seq in range(200)]
+    kept = sum(decisions)
+    assert 0 < kept < 200  # actually samples, both ways
+    # a different seed draws a different subset
+    c = SamplingPolicy(rate=0.5, seed=12)
+    assert decisions != [c._keep(seq) for seq in range(200)]
+
+
+def test_sampling_policy_admission_reasons():
+    policy = SamplingPolicy(tenant_quota=2,
+                            filter=lambda prompt, tokens: len(tokens) > 1)
+    assert policy.admit(0, "t", 0, [1], [2, 3]) is None
+    assert policy.admit(1, "t", 2, [1], [2, 3]) == "quota"
+    assert policy.admit(2, "t", 0, [1], [2]) == "filtered"
+    assert SamplingPolicy(rate=0.0).admit(3, "t", 0, [1], [2]) == "sampled"
+
+
+def test_sampling_policy_validation():
+    with pytest.raises(ValueError):
+        SamplingPolicy(rate=1.5)
+    with pytest.raises(ValueError):
+        SamplingPolicy(tenant_quota=0)
+
+
+# -------------------------------------------------- capture + publication
+
+
+def test_capture_rotates_into_memmap_windows(tmp_path):
+    d = str(tmp_path / "cap")
+    registry = Registry()
+    log = TrafficLog(d, window_samples=4, max_len=8, registry=registry)
+    for i in range(9):
+        req, res = _gen(i, tenant="t")
+        assert log.record(req, res) is True
+    assert published_windows(d) == [0, 1]
+    assert log.pending == 1  # the ninth sample waits for the next window
+    manifest = load_window_manifest(d, 1)
+    assert manifest["samples"] == 4
+    assert manifest["first_seq"] == 4 and manifest["last_seq"] == 7
+    assert manifest["tenants"] == {"t": 4}
+    assert verify_window(d, 0) is None and verify_window(d, 1) is None
+    source = window_source(d, 0)
+    feats, lens = source.local_arrays()
+    assert feats.shape == (4, 8) and feats.dtype == np.int32
+    req0, res0 = _gen(0, tenant="t")
+    merged = [int(t) for t in req0.prompt + res0.tokens]
+    assert feats[0, :len(merged)].tolist() == merged
+    assert int(lens[0]) == len(merged)
+    snap = registry.snapshot()
+    assert snap["online_samples_ingested_total"]["value"] == 9
+    assert snap["online_windows_published_total"]["value"] == 2
+    log.close()
+
+
+def test_capture_tenant_quota_caps_hot_tenant(tmp_path):
+    d = str(tmp_path / "cap")
+    registry = Registry()
+    log = TrafficLog(d, window_samples=4, max_len=8,
+                     policy=SamplingPolicy(tenant_quota=2), registry=registry)
+    # 75% hot traffic: the quota must cap hot at 2 per window while the
+    # cold tenant still gets through and windows keep rotating
+    admitted = [log.record(*_gen(i, tenant="hot" if i % 4 < 3 else "cold"))
+                for i in range(16)]
+    assert published_windows(d) == [0, 1]
+    for w in published_windows(d):
+        tenants = load_window_manifest(d, w)["tenants"]
+        assert tenants["hot"] <= 2
+        assert tenants["cold"] >= 1
+    drops = admitted.count(False)
+    assert drops > 0
+    snap = registry.snapshot()
+    assert snap["online_quota_drops_total"]["value"] == drops
+    assert snap["online_samples_dropped_total"]["value"] == drops
+    assert log.dropped()["quota"] == drops
+    log.close()
+
+
+def test_capture_flush_publishes_partial_window(tmp_path):
+    d = str(tmp_path / "cap")
+    log = TrafficLog(d, window_samples=64, max_len=8)
+    for i in range(3):
+        log.record(*_gen(i))
+    assert log.flush() == 0
+    assert load_window_manifest(d, 0)["samples"] == 3
+    assert log.flush() is None  # nothing pending
+    log.close()
+
+
+def test_verify_window_catches_torn_shard(tmp_path):
+    d = str(tmp_path / "cap")
+    log = TrafficLog(d, window_samples=2, max_len=8)
+    for i in range(2):
+        log.record(*_gen(i))
+    log.close()
+    shard = os.path.join(d, "window_000000.features.npy")
+    with open(shard, "r+b") as fh:
+        fh.truncate(os.path.getsize(shard) - 8)
+    assert "bytes" in verify_window(d, 0)
+
+
+def test_atomic_write_npy_roundtrip_and_no_tmp_left(tmp_path):
+    path = str(tmp_path / "a.npy")
+    arr = np.arange(12, dtype=np.int32).reshape(3, 4)
+    atomic_write_npy(path, arr)
+    assert (np.load(path) == arr).all()
+    assert not os.path.exists(path + ".tmp")
+
+
+# ------------------------------------------------------------ crash resume
+
+
+def test_capture_plain_restart_resumes_cursor(tmp_path):
+    d = str(tmp_path / "cap")
+    log = TrafficLog(d, window_samples=4, max_len=8)
+    for i in range(6):
+        log.record(*_gen(i, tenant="t"))
+    log.close()
+    resumed = TrafficLog(d, window_samples=4, max_len=8)
+    assert resumed.next_seq == 6
+    assert resumed.window == 1
+    assert resumed.pending == 2  # the two carry-over rows survived
+    for i in range(6, 8):
+        resumed.record(*_gen(i, tenant="t"))
+    assert published_windows(d) == [0, 1]
+    resumed.close()
+
+
+def test_capture_resume_after_kill_between_rotate_and_manifest(tmp_path):
+    """The satellite-3 property: a seeded kill BETWEEN shard rotation and
+    manifest publish (chaos ``window_rotate`` site), then resume — the
+    interrupted publication completes idempotently and every subsequent
+    byte matches an uninterrupted reference capture: no sample lost, none
+    duplicated, DataState sidecar included."""
+    kwargs = dict(window_samples=4, max_len=8)
+    policy = lambda: SamplingPolicy(tenant_quota=3, seed=5)
+
+    ref_dir = str(tmp_path / "ref")
+    ref = TrafficLog(ref_dir, policy=policy(), **kwargs)
+    for i in range(14):
+        ref.record(*_gen(i, tenant=f"t{i % 2}"))
+    ref.close()
+
+    kill_dir = str(tmp_path / "kill")
+    chaos.configure("23:kill_rotate=2")
+    log = TrafficLog(kill_dir, policy=policy(), **kwargs)
+    killed = 0
+    for i in range(14):
+        req, res = _gen(i, tenant=f"t{i % 2}")
+        try:
+            log.record(req, res)
+        except chaos.ChaosKilled:
+            # the offered sample was journaled before the kill: the resumed
+            # log owns it — re-offering here would be the duplication bug
+            killed += 1
+            chaos.configure("")
+            log = TrafficLog(kill_dir, policy=policy(), **kwargs)
+    log.close()
+    assert killed == 1, "the seeded mid-rotation kill must fire"
+
+    assert _capture_digest(kill_dir) == _capture_digest(ref_dir)
+    # no loss, no duplication: published windows own contiguous,
+    # non-overlapping seq ranges that exactly tile the admitted stream
+    windows = published_windows(kill_dir)
+    assert windows == published_windows(ref_dir) == [0, 1, 2]
+    next_seq = 0
+    for w in windows:
+        m = load_window_manifest(kill_dir, w)
+        assert m["first_seq"] == next_seq
+        assert m["samples"] == m["last_seq"] - m["first_seq"] + 1 == 4
+        feats, _ = window_source(kill_dir, w).local_arrays()
+        assert len(feats) == 4
+        next_seq = m["last_seq"] + 1
+    with open(os.path.join(kill_dir, "capture_state.json")) as fh:
+        state = json.load(fh)
+    assert DataState.from_json(state["data_state"]).block_cursor == 14
+
+
+def test_capture_resume_completes_interrupted_rotation_only_once(tmp_path):
+    d = str(tmp_path / "cap")
+    chaos.configure("7:kill_rotate=0")
+    log = TrafficLog(d, window_samples=3, max_len=8)
+    with pytest.raises(chaos.ChaosKilled):
+        for i in range(3):
+            log.record(*_gen(i))
+    chaos.configure("")
+    assert published_windows(d) == []  # shards landed, manifest did not
+    resumed = TrafficLog(d, window_samples=3, max_len=8)
+    assert published_windows(d) == [0]  # completed on resume
+    assert resumed.pending == 0 and resumed.window == 1
+    assert verify_window(d, 0) is None
+    # resuming again is a no-op, not a re-publication
+    resumed.close()
+    again = TrafficLog(d, window_samples=3, max_len=8)
+    assert published_windows(d) == [0] and again.next_seq == 3
+    again.close()
+
+
+# -------------------------------------------------------- window scheduler
+
+
+def _np_train_fn(calls):
+    def train_fn(window, source):
+        feats, lens = source.local_arrays()
+        calls.append((window, len(feats)))
+        return {"w": np.full((2, 2), float(window + 1), np.float32),
+                "rows": np.asarray([len(feats)], np.int32)}
+    return train_fn
+
+
+def test_window_scheduler_trains_published_windows(tmp_path):
+    cap = str(tmp_path / "cap")
+    ckpt = str(tmp_path / "ckpt")
+    log = TrafficLog(cap, window_samples=3, max_len=8)
+    for i in range(6):
+        log.record(*_gen(i))
+    log.close()
+    calls = []
+    registry = Registry()
+    sched = WindowScheduler(cap, _np_train_fn(calls), ckpt,
+                            registry=registry)
+    assert sched.pending_windows() == [0, 1]
+    assert sched.step_once() == 0
+    assert sched.step_once() == 1
+    assert sched.step_once() is None
+    assert calls == [(0, 3), (1, 3)]
+    from distkeras_tpu.checkpoint import (
+        committed_steps,
+        restore_checkpoint,
+        restore_data_state,
+    )
+
+    assert committed_steps(ckpt) == [1, 2]
+    state = restore_checkpoint(ckpt, step=2, verify="full")
+    assert float(np.asarray(state["w"])[0, 0]) == 2.0
+    ds = restore_data_state(ckpt, step=2)
+    assert ds.epoch == 1
+    assert ds.block_cursor == load_window_manifest(cap, 1)["last_seq"] + 1
+    snap = registry.snapshot()
+    assert snap["online_windows_trained_total"]["value"] == 2
+    assert snap["online_retrain_seconds"]["count"] == 2
+    # restart safety: a new scheduler baselines on committed steps and
+    # never re-trains a closed window
+    calls2 = []
+    sched2 = WindowScheduler(cap, _np_train_fn(calls2), ckpt)
+    assert sched2.trained == 1
+    assert sched2.step_once() is None and calls2 == []
+
+
+def test_window_scheduler_retries_chaos_killed_epoch(tmp_path):
+    cap = str(tmp_path / "cap")
+    log = TrafficLog(cap, window_samples=2, max_len=8)
+    for i in range(2):
+        log.record(*_gen(i))
+    log.close()
+    calls = []
+    registry = Registry()
+    chaos.configure("3:kill_epoch=0")
+    sched = WindowScheduler(cap, _np_train_fn(calls), str(tmp_path / "ckpt"),
+                            registry=registry)
+    assert sched.step_once() == 0  # first attempt killed, retry trains
+    assert calls == [(0, 2)]
+    snap = registry.snapshot()
+    assert snap["online_retrain_failures_total"]["value"] == 1
+
+
+def test_window_scheduler_refuses_torn_window(tmp_path):
+    cap = str(tmp_path / "cap")
+    log = TrafficLog(cap, window_samples=2, max_len=8)
+    for i in range(2):
+        log.record(*_gen(i))
+    log.close()
+    shard = os.path.join(cap, "window_000000.labels.npy")
+    with open(shard, "r+b") as fh:
+        fh.truncate(os.path.getsize(shard) - 4)
+    sched = WindowScheduler(cap, _np_train_fn([]), str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="shard verification"):
+        sched.step_once()
+
+
+def test_window_scheduler_background_loop(tmp_path):
+    import time as _time
+
+    cap = str(tmp_path / "cap")
+    log = TrafficLog(cap, window_samples=2, max_len=8)
+    calls = []
+    sched = WindowScheduler(cap, _np_train_fn(calls), str(tmp_path / "ckpt"),
+                            poll_interval=0.02)
+    sched.start()
+    try:
+        for i in range(4):
+            log.record(*_gen(i))
+        deadline = _time.monotonic() + 10
+        while len(calls) < 2 and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+    finally:
+        sched.stop()
+        log.close()
+    assert [w for w, _ in calls] == [0, 1]
+    assert sched.status()["windows_trained"] == 2
+    assert sched.status()["pending"] == []
+
+
+# --------------------------------------------------------------- placement
+
+
+def test_plan_placement_trainer_on_largest_member():
+    members = {"a": {"workers": 2}, "b": {"workers": 8}, "c": {"workers": 4}}
+    plan = plan_placement(members, replicas=3)
+    assert plan["trainer"] == "b"
+    assert sum(plan["replicas"].values()) == 3
+    assert "b" not in plan["replicas"]  # enough capacity without the trainer
+    assert plan["capacity"] == 14
+
+
+def test_plan_placement_small_fleet_shares_trainer():
+    plan = plan_placement({"only": {"workers": 2}}, replicas=2)
+    assert plan["trainer"] == "only"
+    assert plan["replicas"] == {"only": 2}
+    overflow = plan_placement({"big": {"workers": 4}, "tiny": {"workers": 1}},
+                              replicas=3)
+    assert overflow["trainer"] == "big"
+    assert overflow["replicas"]["tiny"] >= 1
+    assert sum(overflow["replicas"].values()) == 3
+
+
+def test_plan_placement_empty_fleet():
+    assert plan_placement({}, replicas=2) == {
+        "trainer": None, "replicas": {}, "capacity": 0}
+
+
+# ------------------------------------------------------------ daemon verbs
+
+
+@pytest.fixture
+def punchcard(tmp_path):
+    workdir = tmp_path / "punchcard"
+    workdir.mkdir()
+    server = PunchcardServer(port=0, secret="s3cret", workdir=str(workdir))
+    server.start()
+    yield server
+    server.stop()
+
+
+SLEEPER = "import time\ntime.sleep(60)\n"
+
+
+def test_daemon_online_loop_status_stop(punchcard):
+    job = Job("127.0.0.1", punchcard.port, secret="s3cret", script=SLEEPER)
+    job._rpc({"action": "register", "worker_id": "w-big", "workers": 4})
+    job._rpc({"action": "register", "worker_id": "w-small", "workers": 1})
+    online_id = job.online_loop(replicas=2, trainer_script=SLEEPER)
+    assert job.online_id == online_id and job.tier_id
+    st = job.online_status()
+    assert st["status"] == "ok"
+    assert len(st["replicas"]) == 2 and st["serving"] == 2
+    assert st["trainer"]["status"] == "serving"
+    assert st["windows_published"] == 0 and st["steps_published"] == 0
+    assert st["placement"]["trainer"] == "w-big"
+    assert os.path.isdir(st["capture_dir"])
+    assert os.path.isdir(st["checkpoint_dir"])
+    stopped = job.stop_online()
+    assert stopped["status"] == "stopped" and stopped["stopped"] == 3
+    assert job.online_status(online_id)["status"] == "unknown"
+    assert job.tier_status()["status"] == "unknown"  # tier went with it
+
+
+def test_daemon_online_status_counts_windows_and_steps(punchcard, tmp_path):
+    cap = str(tmp_path / "cap")
+    ckpt = str(tmp_path / "ckpt")
+    job = Job("127.0.0.1", punchcard.port, secret="s3cret", script=SLEEPER)
+    job.online_loop(replicas=1, trainer_script=SLEEPER,
+                    capture_dir=cap, checkpoint_dir=ckpt)
+    log = TrafficLog(cap, window_samples=2, max_len=8)
+    for i in range(4):
+        log.record(*_gen(i))
+    log.close()
+    WindowScheduler(cap, _np_train_fn([]), ckpt).step_once()
+    st = job.online_status()
+    assert st["windows_published"] == 2
+    assert st["steps_published"] == 1
+    job.stop_online()
+
+
+def test_daemon_online_unknown_ids(punchcard):
+    job = Job("127.0.0.1", punchcard.port, secret="s3cret", script=SLEEPER)
+    assert job.online_status("nope")["status"] == "unknown"
+    assert job.stop_online("nope")["status"] == "unknown"
+    with pytest.raises(RuntimeError):
+        job.online_status()
+
+
+# ---------------------------------------------------- frontend capture hook
+
+
+class _FakePending:
+    def __init__(self, result):
+        self._result = result
+
+    def result(self, timeout=None):
+        return self._result
+
+
+class _FakeEngine:
+    def __init__(self, result):
+        self._result = result
+        self.submitted = []
+
+    def submit(self, req):
+        self.submitted.append(req)
+        return _FakePending(self._result)
+
+
+def _install(engine, traffic_log, monkeypatch):
+    from distkeras_tpu.serving import frontend
+    from distkeras_tpu.telemetry.flightdeck import server as server_mod
+
+    handlers = {}
+    monkeypatch.setattr(server_mod, "add_endpoint",
+                        lambda path, fn: handlers.update({path: fn}))
+    frontend.install_http_endpoint(engine, traffic_log=traffic_log)
+    return handlers["/generate"]
+
+
+def test_frontend_records_successful_generation(monkeypatch):
+    result = GenerateResult(request_id="r", prompt=[1, 2], tokens=[3],
+                            finish_reason="length")
+    engine = _FakeEngine(result)
+    recorded = []
+
+    class _Log:
+        def record(self, req, res):
+            recorded.append((req, res))
+            return True
+
+    handle = _install(engine, _Log(), monkeypatch)
+    body = json.dumps({"prompt": [1, 2], "tenant": "acme"})
+    _, _, status = handle({"method": "POST", "body": body})[:3]
+    assert status == 200
+    assert len(recorded) == 1
+    assert recorded[0][0].tenant == "acme"
+    assert recorded[0][1] is result
+
+
+def test_frontend_tenant_header_fallback(monkeypatch):
+    engine = _FakeEngine(GenerateResult(request_id="r", prompt=[1],
+                                        tokens=[2], finish_reason="length"))
+    recorded = []
+
+    class _Log:
+        def record(self, req, res):
+            recorded.append(req)
+
+    handle = _install(engine, _Log(), monkeypatch)
+    handle({"method": "POST", "body": json.dumps({"prompt": [1]}),
+            "headers": {"x-dk-tenant": "hdr-tenant"}})
+    assert recorded[0].tenant == "hdr-tenant"
+
+
+def test_frontend_capture_failure_never_breaks_serving(monkeypatch):
+    engine = _FakeEngine(GenerateResult(request_id="r", prompt=[1],
+                                        tokens=[2], finish_reason="length"))
+
+    class _ExplodingLog:
+        def record(self, req, res):
+            raise RuntimeError("capture disk full")
+
+    handle = _install(engine, _ExplodingLog(), monkeypatch)
+    _, body, status = handle(
+        {"method": "POST", "body": json.dumps({"prompt": [1]})})[:3]
+    assert status == 200  # the client never sees the capture fault
+    assert json.loads(body)["tokens"] == [2]
+
+
+def test_frontend_no_capture_on_aborted(monkeypatch):
+    engine = _FakeEngine(GenerateResult(request_id="r", prompt=[1], tokens=[],
+                                        finish_reason="aborted"))
+    recorded = []
+
+    class _Log:
+        def record(self, req, res):
+            recorded.append(req)
+
+    handle = _install(engine, _Log(), monkeypatch)
+    out = handle({"method": "POST", "body": json.dumps({"prompt": [1]})})
+    assert out[2] == 503
+    assert recorded == []  # failed generations are not training data
