@@ -480,12 +480,35 @@ def _probe_persistent_daemon() -> dict:
 
 
 def _diagnose_init_failure(reason: str, attempts: list) -> dict:
-    return {
+    diagnosis = {
         "reason": reason,
         "classified": _classify_init_failure(reason),
         "attempts": list(attempts),
         "daemon_probe": _probe_persistent_daemon(),
     }
+    _export_init_diagnosis(diagnosis)
+    return diagnosis
+
+
+def _export_init_diagnosis(diagnosis: dict) -> None:
+    """Make the fallback WHY observable off-box, not just buried in the
+    emitted JSON row: the reason string rides ``/vars`` as the
+    ``bench_backend_init_reason`` flightdeck var (strings don't fit a
+    metric), and a per-class counter family makes the cause aggregable
+    across the fleet scrape."""
+    from distkeras_tpu import telemetry
+
+    if not telemetry.enabled():
+        return
+    telemetry.flightdeck.set_var("bench_backend_init_reason", {
+        "classified": diagnosis["classified"],
+        "reason": diagnosis["reason"],
+        "attempts": len(diagnosis["attempts"]),
+    })
+    telemetry.metrics.counter(
+        f"bench_backend_init_{diagnosis['classified']}_total",
+        help="failed bench backend inits by failure class",
+    ).inc()
 
 
 def preflight(max_tries: Optional[int] = None,

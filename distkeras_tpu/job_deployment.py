@@ -26,7 +26,7 @@ import tempfile
 import threading
 import time
 import uuid
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from distkeras_tpu import chaos as _chaos
 from distkeras_tpu import telemetry
@@ -527,6 +527,8 @@ class PunchcardServer:
                 send_data(conn, reply)
             elif action == "aggregate":
                 send_data(conn, {"status": "ok", **self._fleet_snapshot()})
+            elif action == "slo_status":
+                send_data(conn, {"status": "ok", **self._fleet_slo()})
             else:
                 send_data(conn, {"status": "bad_request"})
         except TimeoutError:
@@ -888,20 +890,26 @@ class PunchcardServer:
                 return max(mtimes)
         return None
 
-    def _job_live_vars(self, job: dict) -> Optional[dict]:
-        """Scrape a still-running job's ``/vars`` (live metrics snapshot +
-        dynamics summary); ``None`` when the job has no live exporter."""
+    def _job_live_json(self, job: dict, path: str) -> Optional[dict]:
+        """GET one JSON endpoint off a still-running job's flightdeck
+        exporter; ``None`` when the job has no live exporter (or the scrape
+        fails — a dead job must not fail the fleet view)."""
         addr = self._job_http_address(job)
         if not addr:
             return None
         try:
             import urllib.request
 
-            with urllib.request.urlopen(f"http://{addr}/vars",
+            with urllib.request.urlopen(f"http://{addr}/{path}",
                                         timeout=1.0) as resp:
                 return json.loads(resp.read().decode("utf-8"))
         except (OSError, ValueError):
             return None
+
+    def _job_live_vars(self, job: dict) -> Optional[dict]:
+        """Scrape a still-running job's ``/vars`` (live metrics snapshot +
+        dynamics summary); ``None`` when the job has no live exporter."""
+        return self._job_live_json(job, "vars")
 
     def _fleet_snapshot(self) -> dict:
         """Merged metric snapshot across every job that reported metrics —
@@ -916,6 +924,43 @@ class PunchcardServer:
         merged = merge_snapshots(snaps)
         return {"jobs": len(snaps), "snapshot": merged,
                 "prometheus": prometheus_from_snapshot(merged)}
+
+    def _fleet_slo(self) -> dict:
+        """Fleet SLO + rollup view (``slo_status`` verb): every live job's
+        ``/slo`` engines plus the daemon's own, and the jobs' rollup rings
+        merged onto one time axis — what ``dkmon status/watch/check`` and
+        the future autoscaler verb consume."""
+        from distkeras_tpu.telemetry import slo as _slo
+        from distkeras_tpu.telemetry.flightdeck.rollup import merge_series
+
+        engines: Dict[str, dict] = {}
+        firing: List[dict] = []
+        series: List[dict] = []
+
+        def _collect(owner: str, status_by_source: Dict[str, dict]) -> None:
+            for src, st in (status_by_source or {}).items():
+                engines[f"{owner}:{src}"] = st
+                for row in st.get("objectives", ()):
+                    if row.get("firing"):
+                        firing.append({"owner": owner, "source": src, **row})
+
+        with self._cv:
+            jobs = list(self.jobs.items())
+        for jid, job in jobs:
+            body = self._job_live_json(job, "slo")
+            if body:
+                _collect(jid, body.get("engines"))
+            ts = self._job_live_json(job, "timeseries")
+            if ts and ts.get("samples"):
+                series.append(ts)
+        _collect("daemon",
+                 {src: e.status() for src, e in _slo.engines().items()})
+        align = max((float(p.get("interval") or 1.0) for p in series),
+                    default=1.0)
+        merged = (merge_series(series, align_s=align) if series
+                  else {"interval": align, "capacity": 0, "samples": []})
+        return {"engines": engines, "firing": firing,
+                "firing_count": len(firing), "timeseries": merged}
 
 
 class Job:
@@ -1175,6 +1220,15 @@ class Job:
         summed, gauges max'd (mean alongside), histograms merged on their
         bounded-bucket representation."""
         return self._rpc({"action": "aggregate"})
+
+    def slo_status(self) -> dict:
+        """Fleet SLO view (``slo_status`` verb): ``{"engines": {"<owner>:
+        <source>": <status>}, "firing": [...], "firing_count": N,
+        "timeseries": <merged rollup>}`` — every live job's ``/slo``
+        engines plus the daemon's own, and the jobs' rollup rings merged
+        onto one time axis.  ``dkmon status --daemon host:port`` renders
+        this; ``dkmon check`` gates on ``firing_count``."""
+        return self._rpc({"action": "slo_status"})
 
     def wait(self, timeout: float = 300.0, poll: float = 0.2) -> dict:
         # monotonic, not wall-clock: an NTP step mid-poll must not shrink or

@@ -96,13 +96,19 @@ class WindowScheduler:
     def __init__(self, capture_dir: str, train_fn: Callable,
                  checkpoint_dir: str, *, poll_interval: float = 0.25,
                  step_offset: int = 1, max_retries: int = 3,
-                 registry=None, clock=time.monotonic):
+                 registry=None, window_span_s: float = 30.0,
+                 slo_objectives=None, clock=time.monotonic):
         self.capture_dir = capture_dir
         self.checkpoint_dir = checkpoint_dir
         self.train_fn = train_fn
         self.poll_interval = float(poll_interval)
         self.step_offset = int(step_offset)
         self.max_retries = int(max_retries)
+        # window_span_s: expected wall-clock cadence of window publication;
+        # the shipped SLO alerts once the untrained backlog ages past 2x it.
+        self.window_span_s = float(window_span_s)
+        self._slo_objectives = slo_objectives
+        self._slo = None
         self._clock = clock
         self._metrics = online_metrics(registry)
         self._seen: Dict[int, float] = {}  # window -> first-seen monotonic
@@ -202,15 +208,29 @@ class WindowScheduler:
         :meth:`stop`.  A failed window (exhausted retries, torn shards) is
         left pending and re-attempted next poll rather than killing the
         loop."""
+        from distkeras_tpu.telemetry import slo as _slo
+
+        objectives = self._slo_objectives
+        if objectives is None:
+            objectives = _slo.default_online_objectives(self.window_span_s)
+        # None unless telemetry + DISTKERAS_ROLLUP are on — the flag-off
+        # polling loop is untouched.
+        engine = _slo.maybe_engine(objectives, source="online")
         with self._lock:
             if self._thread is not None:
                 return
+            if self._slo is None:
+                self._slo = engine
             self._stop.clear()
 
             def _loop():
                 while not self._stop.wait(self.poll_interval):
                     try:
                         self.step_once()
+                        with self._lock:
+                            slo_engine = self._slo
+                        if slo_engine is not None:
+                            slo_engine.evaluate()
                     except Exception:  # noqa: BLE001 — retried next poll
                         continue
 

@@ -420,6 +420,7 @@ class ServingTier:
                  backoff_s: float = 0.02,
                  backoff_cap_s: float = 0.25,
                  registry=None,
+                 slo_objectives: Optional[Sequence] = None,
                  clock: Callable[[], float] = time.monotonic):
         if not replicas:
             raise ValueError("a serving tier needs at least one replica")
@@ -451,6 +452,11 @@ class ServingTier:
         self._stop_evt: Optional[threading.Event] = None
         self._probe_thread: Optional[threading.Thread] = None
         self._watchers: List[Tuple[threading.Event, threading.Thread]] = []
+        # SLO evaluation rides the probe loop; None until start() and only
+        # ever non-None when telemetry + DISTKERAS_ROLLUP are on, so the
+        # flag-off dispatch/probe path is untouched.
+        self._slo_objectives = slo_objectives
+        self._slo = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -466,6 +472,14 @@ class ServingTier:
                 target=self._probe_loop, name="serving-tier-probe",
                 daemon=True)
             self._probe_thread.start()
+        if self._slo is None:
+            from distkeras_tpu.telemetry import slo as _slo
+
+            objectives = self._slo_objectives
+            if objectives is None:
+                objectives = _slo.default_serving_objectives()
+            self._slo = _slo.maybe_engine(
+                objectives, source="serving_tier", registry=self._registry)
 
     def stop(self, close_replicas: bool = False) -> None:
         """Stop the prober and any checkpoint watchers; optionally stop
@@ -491,6 +505,8 @@ class ServingTier:
         while stop is not None and not stop.wait(self.probe_interval):
             try:
                 self.probe_once()
+                if self._slo is not None:
+                    self._slo.evaluate()
             except Exception:  # noqa: BLE001 — a failed sweep/export must
                 # not kill the supervisor; the next round retries it
                 continue

@@ -35,22 +35,27 @@ __all__ = [
     "address",
     "blackbox_dump",
     "current_run_id",
+    "ensure_rollup",
     "ensure_server",
     "http_port",
     "on_crash",
     "recorder",
+    "rollup_ring",
     "run_id",
     "set_run_id",
+    "set_var",
     "stop_server",
 ]
 
 
 def activate():
-    """The one call entry points make: mint/propagate the fleet ``run_id``
-    and start the HTTP exporter when one is configured.  Returns the run id.
+    """The one call entry points make: mint/propagate the fleet ``run_id``,
+    start the HTTP exporter when one is configured, and start the rollup
+    ring when ``DISTKERAS_ROLLUP`` asks for one.  Returns the run id.
     """
     rid = run_id()
     ensure_server()
+    ensure_rollup()
     return rid
 
 
@@ -84,3 +89,21 @@ def add_endpoint(path, fn):
     from distkeras_tpu.telemetry.flightdeck import server
 
     return server.add_endpoint(path, fn)
+
+
+def set_var(name, value):
+    from distkeras_tpu.telemetry.flightdeck import server
+
+    return server.set_var(name, value)
+
+
+def ensure_rollup():
+    from distkeras_tpu.telemetry.flightdeck import rollup
+
+    return rollup.ensure_rollup()
+
+
+def rollup_ring():
+    from distkeras_tpu.telemetry.flightdeck import rollup
+
+    return rollup.rollup_ring()

@@ -24,6 +24,10 @@ Endpoints:
     The flight-recorder ring as Chrome trace JSON (open in Perfetto).
     ``?request_id=`` / ``?trace_id=`` filter the span events to one
     request's trace — the live half of ``dktrace critical-path``.
+``/timeseries``
+    The rollup ring (``DISTKERAS_ROLLUP``): fixed-interval history of every
+    instrument, the raw feed for SLO burn rates and ``dkmon watch``.
+    ``?since=<unix>`` / ``?name=<metric>`` (repeatable) filter the samples.
 
 Handlers only *read* registry snapshots and the recorder ring (each guarded
 by its own cheap lock), so scraping never blocks the training loop.  The
@@ -49,7 +53,9 @@ __all__ = [
     "address",
     "configure",
     "ensure_server",
+    "get_vars",
     "http_port",
+    "set_var",
     "stop",
 ]
 
@@ -68,6 +74,23 @@ _LOCK = threading.Lock()
 # {"method", "query", "body", "headers"} and may return a (ctype, body,
 # status) triple (how the serving /generate endpoint speaks 400/503).
 _EXTRA: Dict[str, Callable] = {}
+
+# Free-form string/scalar vars surfaced under /vars "vars": the place for
+# one-off facts that are not metric-shaped (e.g. bench's
+# bench_backend_init_reason — *why* the device backend fell back).
+_VARS: Dict[str, object] = {}
+_VARS_LOCK = threading.Lock()
+
+
+def set_var(name: str, value) -> None:
+    """Publish a JSON-safe scalar under ``/vars``' ``"vars"`` key."""
+    with _VARS_LOCK:
+        _VARS[str(name)] = value
+
+
+def get_vars() -> Dict[str, object]:
+    with _VARS_LOCK:
+        return dict(_VARS)
 
 
 def http_port() -> Optional[int]:
@@ -256,8 +279,13 @@ def _render(path: str, request: Optional[dict] = None):
             "metrics": _registry.snapshot(),
             "phase_breakdown": _registry.phase_breakdown(),
             "dynamics": _dynamics.last_summary(),
+            "vars": get_vars(),
         }
         return ("application/json", json.dumps(body), 200)
+    if path == "/timeseries":
+        from distkeras_tpu.telemetry.flightdeck import rollup as _rollup
+
+        return _rollup.timeseries_view(request)
     if path == "/trace":
         payload = rec.trace_export(origin=_tracer._origin)
         query = parse_qs((request or {}).get("query") or "")
@@ -308,7 +336,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(500, "text/plain", f"{type(e).__name__}: {e}")
             return
         if payload is None:
-            known = ["/metrics", "/healthz", "/vars", "/trace", *sorted(_EXTRA)]
+            known = ["/metrics", "/healthz", "/vars", "/trace",
+                     "/timeseries", *sorted(_EXTRA)]
             self._reply(404, "text/plain", "not found; endpoints: " + " ".join(known))
             return
         ctype, text, status = payload[:3]

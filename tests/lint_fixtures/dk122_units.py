@@ -1,0 +1,41 @@
+"""DK122 fixture — metric unit/suffix hygiene.
+
+Package-scoped rule: the test copies this file into a synthetic
+``distkeras_tpu`` package under tmp_path (no golden needed — DK122 judges
+the name alone).  Expected findings, by line:
+
+  * counter without ``_total`` (two spellings);
+  * duration histograms in the wrong unit (``_ms`` suffix, ``latency``
+    token, bare ``_time``);
+  * byte gauge without ``_bytes``.
+
+Keep edits append-only or update the test.
+"""
+
+
+def register(registry):
+    # counters must end _total
+    registry.counter("fixture_requests", help="missing suffix entirely")
+    registry.counter("fixture_stall_seconds", help="a seconds tally is still a counter")
+    # duration histograms must end _seconds
+    registry.histogram("fixture_step_ms", help="milliseconds ladder lie")
+    registry.histogram("fixture_queue_latency", help="latency token, no unit")
+    registry.histogram("fixture_publish_time", help="_time is not a unit")
+    # byte gauges must end _bytes
+    registry.gauge("fixture_ring_byte_usage", help="bytes without the suffix")
+    return registry
+
+
+def register_clean(registry):
+    # canonical spellings: all clean
+    registry.counter("fixture_requests_total", help="events")
+    registry.histogram("fixture_step_seconds", help="wall seconds")
+    registry.histogram("fixture_queue_latency_seconds", help="wall seconds")
+    registry.gauge("fixture_ring_bytes", help="resident bytes")
+    registry.gauge("fixture_inflight", help="unitless gauge: fine")
+    # non-duration histogram (a count distribution): fine
+    registry.histogram("fixture_request_attempts", help="attempts per request")
+    # computed families are out of scope
+    kind = "poisoned"
+    registry.counter(f"fixture_{kind}_events", help="family")
+    return registry

@@ -545,6 +545,32 @@ def test_dk121_joined_and_daemon_threads_are_silent():
     assert 33 not in lines  # contained runner loop
 
 
+def test_dk122_unit_hygiene_fixture(tmp_path):
+    assert _run_in_package(tmp_path, "dk122_units.py", ["DK122"]) == [
+        ("DK122", 18),  # counter without _total
+        ("DK122", 19),  # seconds tally is still a counter: needs _total
+        ("DK122", 21),  # duration histogram in milliseconds (_ms)
+        ("DK122", 22),  # latency token, no unit suffix
+        ("DK122", 23),  # _time is not a unit
+        ("DK122", 25),  # byte gauge without _bytes
+    ]
+
+
+def test_dk122_canonical_names_are_silent(tmp_path):
+    lines = [ln for _, ln in _run_in_package(
+        tmp_path, "dk122_units.py", ["DK122"])]
+    # register_clean spans lines 29-41: canonical suffixes, unitless gauge,
+    # a non-duration histogram, and a computed family are all clean
+    assert not any(29 <= ln <= 41 for ln in lines)
+
+
+def test_dk122_out_of_package_is_silent():
+    """Same registrations outside the distkeras_tpu package stay unflagged
+    — naming conventions only bind the shipped instrument set."""
+    got, _ = _run("dk122_units.py", ["DK122"])
+    assert got == []
+
+
 def test_fixed_modules_stay_concurrency_clean():
     """Regression pins for the in-tree fixes: modules whose DK119/DK120/
     DK121 findings were *fixed* (not baselined) must stay clean when
@@ -697,6 +723,7 @@ def test_all_rules_registered():
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
         "DK108", "DK109", "DK110", "DK111", "DK112", "DK113", "DK114",
         "DK115", "DK116", "DK117", "DK118", "DK119", "DK120", "DK121",
+        "DK122",
     ]
 
 

@@ -1,0 +1,93 @@
+"""DK122 — metric unit/suffix hygiene (extends DK114's name hygiene).
+
+Prometheus naming conventions are load-bearing here, not cosmetic: the
+fleet merge sums anything typed counter (only meaningful for ``_total``
+event tallies), the SLO engine computes ``rate()``-style deltas keyed on
+the same assumption, and dashboards convert ``_seconds``/``_bytes``
+suffixes into axis units.  A counter named like a gauge (or a duration
+histogram in implied milliseconds) produces charts that are silently wrong
+by construction.  Three checks over every literal
+``registry.counter/gauge/histogram("name", ...)`` in the package:
+
+  * counters must end ``_total``;
+  * histograms whose names imply a duration (``latency``, ``duration``,
+    ``wait``, ``ttft``, ``time`` tokens, or a wrong unit suffix like
+    ``_secs``/``_ms``) must end ``_seconds`` — the bucket ladder is a
+    seconds ladder, so any other unit misreads it;
+  * gauges measuring bytes must end ``_bytes``.
+
+F-string / computed families are out of scope, same as DK114.  Scope:
+``distkeras_tpu`` modules.  Pre-existing names that are pinned by golden
+files or CI greps are baselined with reasons rather than renamed — the
+rule exists to stop *new* drift.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tools.dklint.core import Checker, FileInfo, Finding, Project
+from tools.dklint.registry import register
+from tools.dklint.checkers.metric_hygiene import _file_registrations
+
+# Name tokens that imply the instrument measures wall time.
+_DURATION_TOKENS = frozenset(
+    {"latency", "duration", "wait", "ttft", "time", "elapsed"}
+)
+
+# Wrong/ambiguous unit spellings a duration histogram must not end with.
+_WRONG_DURATION_SUFFIXES = (
+    "_secs", "_sec", "_ms", "_msec", "_millis", "_milliseconds", "_us",
+    "_micros", "_nanos", "_time",
+)
+
+
+def _is_duration_name(name: str) -> bool:
+    if name.endswith("_seconds"):
+        return False  # already canonical
+    if name.endswith(_WRONG_DURATION_SUFFIXES):
+        return True
+    tokens = set(name.split("_"))
+    return bool(tokens & _DURATION_TOKENS) or "seconds" in tokens
+
+
+@register
+class UnitHygieneChecker(Checker):
+    rule = "DK122"
+    name = "metric-unit-hygiene"
+    description = (
+        "counters must end _total, duration histograms _seconds, byte "
+        "gauges _bytes"
+    )
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        mod = fi.module or ""
+        if mod != "distkeras_tpu" and not mod.startswith("distkeras_tpu."):
+            return
+        for reg in _file_registrations(fi):
+            why = None
+            if reg.kind == "counter" and not reg.name.endswith("_total"):
+                why = (
+                    f"counter '{reg.name}' must end '_total' — the fleet "
+                    "merge sums it and rate() semantics key on the suffix"
+                )
+            elif reg.kind == "histogram" and _is_duration_name(reg.name):
+                why = (
+                    f"duration histogram '{reg.name}' must end '_seconds' "
+                    "— the default bucket ladder is a seconds ladder; any "
+                    "other unit misreads it"
+                )
+            elif reg.kind == "gauge" and "byte" in reg.name \
+                    and not reg.name.endswith("_bytes"):
+                why = (
+                    f"byte gauge '{reg.name}' must end '_bytes' — "
+                    "dashboards unit-convert on the suffix"
+                )
+            if why is not None:
+                yield Finding(
+                    path=fi.relpath,
+                    line=reg.line,
+                    col=reg.col,
+                    rule=self.rule,
+                    message=f"unit hygiene: {why}",
+                )

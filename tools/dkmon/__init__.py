@@ -1,0 +1,133 @@
+"""``tools.dkmon`` — SLO monitor: status tables, live watch, CI gate.
+
+Three ways to reach the signal plane, one normalized shape out:
+
+* ``--address host:port`` — GET ``/slo`` off a process's flightdeck
+  exporter (a tier, a trainer, the daemon itself);
+* ``--daemon host:port`` — the ``PunchcardServer``'s ``slo_status`` verb:
+  every live job's engines plus the daemon's own, fleet-merged rollups
+  included;
+* ``--incidents path.jsonl`` — the append-only incident log, for post-hoc
+  gating when nothing is live anymore (CI reads the log the smoke run left
+  behind).
+
+Everything returns/consumes ``{"engines": {name: status}, "incidents":
+[...]}`` where ``status`` is :meth:`SLOEngine.status`'s dict — the CLI in
+``__main__`` only renders and gates.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+__all__ = [
+    "fetch_address",
+    "fetch_daemon",
+    "firing_rows",
+    "firing_from_incidents",
+    "load_incidents",
+    "render_status",
+]
+
+
+def fetch_address(address: str, timeout: float = 3.0) -> dict:
+    """Scrape ``/slo`` from a flightdeck exporter at ``host:port``."""
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{address}/slo",
+                                timeout=timeout) as resp:
+        body = json.loads(resp.read().decode("utf-8"))
+    return {"engines": dict(body.get("engines") or {}),
+            "run_id": body.get("run_id"),
+            "incident_log": body.get("incident_log")}
+
+
+def fetch_daemon(host: str, port: int, secret: str = "",
+                 timeout: float = 10.0) -> dict:
+    """Fetch the fleet view through the daemon's ``slo_status`` verb."""
+    from distkeras_tpu.job_deployment import Job
+
+    job = Job(host, port, secret=secret, rpc_timeout=timeout)
+    reply = job.slo_status()
+    if reply.get("status") != "ok":
+        raise ValueError(f"daemon refused slo_status: {reply}")
+    return {"engines": dict(reply.get("engines") or {}),
+            "firing": list(reply.get("firing") or ()),
+            "timeseries": reply.get("timeseries")}
+
+
+def load_incidents(path: str) -> List[dict]:
+    """Parse an incident JSONL log, skipping torn trailing lines (the
+    writer appends whole lines, but the reader may race the final one)."""
+    records = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+    return records
+
+
+def firing_from_incidents(records: List[dict]) -> List[dict]:
+    """Objectives whose *latest* record is a fire without a matching
+    resolve — what is still burning according to the log alone."""
+    last: Dict[tuple, dict] = {}
+    for rec in records:
+        key = (rec.get("source"), rec.get("objective"))
+        last[key] = rec
+    return [rec for rec in last.values() if rec.get("event") == "fire"]
+
+
+def firing_rows(engines: Dict[str, dict]) -> List[dict]:
+    """Flatten every engine's firing objectives into gate-able rows."""
+    rows = []
+    for name, status in sorted(engines.items()):
+        for row in status.get("objectives", ()):
+            if row.get("firing"):
+                rows.append({"engine": name, **row})
+    return rows
+
+
+def _fmt(value: Optional[float], width: int = 9) -> str:
+    if value is None:
+        return "-".rjust(width)
+    return f"{value:.2f}".rjust(width)
+
+
+def render_status(engines: Dict[str, dict],
+                  incidents: Optional[List[dict]] = None) -> str:
+    """The ``dkmon status`` table: one row per objective per engine."""
+    lines = [
+        f"{'OBJECTIVE':<32}{'ENGINE':<22}{'BURN/fast':>10}{'BURN/slow':>10}"
+        f"{'THRESH':>8}  STATE"
+    ]
+    total = firing = 0
+    for name, status in sorted(engines.items()):
+        if not status.get("enabled", True):
+            lines.append(f"{'(rollups off)':<32}{name:<22}")
+            continue
+        for row in status.get("objectives", ()):
+            total += 1
+            state = "ok"
+            if row.get("firing"):
+                firing += 1
+                state = "FIRING"
+                if row.get("since"):
+                    state += f" since {row['since']:.0f}"
+            elif row.get("burn_fast") is None:
+                state = "no-data"
+            lines.append(
+                f"{row['name']:<32}{name:<22}"
+                f"{_fmt(row.get('burn_fast'), 10)}"
+                f"{_fmt(row.get('burn_slow'), 10)}"
+                f"{row['burn_threshold']:>8.1f}  {state}"
+            )
+    lines.append(f"{total} objective(s), {firing} firing")
+    if incidents:
+        lines.append(f"{len(incidents)} incident record(s) in log")
+    return "\n".join(lines)
