@@ -1,0 +1,48 @@
+"""DK123/DK108 interplay fixture: shard_map nested under vmap with a
+shadowed axis name, and compat-wrapped sites resolving to the same specs
+as direct shard_map.  Parsed only."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from distkeras_tpu.utils import compat
+
+MESH = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+
+
+def nested_shadowed(x):
+    """vmap axis name shadows the mesh axis 'dp'.  DK108 must still see
+    the collective's axis as bound (innermost binding wins); DK123 must
+    judge the shard_map specs against the *mesh*, not the vmap axis."""
+
+    def inner(a):
+        return lax.psum(a, "dp")  # NOT flagged: bound by vmap *and* mesh
+
+    mapped = shard_map(inner, mesh=MESH, in_specs=(P("dp"),), out_specs=P())
+    return jax.vmap(mapped, axis_name="dp")(x)  # NOT flagged by DK123
+
+
+def nested_bad_spec(x):
+    """The shadowed vmap axis must not mask a genuinely bad spec."""
+
+    def inner(a):
+        return lax.psum(a, "dp")
+
+    mapped = shard_map(inner, mesh=MESH, in_specs=(P("model"),),
+                       out_specs=P())  # line 34: DK123 axis not in mesh
+    return jax.vmap(mapped, axis_name="model")(x)
+
+
+def compat_parity(x):
+    """compat.shard_map resolves to the same spec judgement as direct."""
+    x = jnp.zeros((8, 128))
+    direct = shard_map(lambda a: a, MESH, in_specs=(P("dp", None, "tp"),),
+                       out_specs=P())
+    wrapped = compat.shard_map(lambda a: a, MESH,
+                               in_specs=(P("dp", None, "tp"),),
+                               out_specs=P())
+    return direct(x), wrapped(x)  # line 47: DK123 twice — both wrong-rank

@@ -1,0 +1,64 @@
+"""DK123 fixture: shard_map partition-spec soundness.  Parsed only."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+MESH = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+
+
+def bad_rank(x):
+    x = jnp.zeros((8, 128))
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp", None, "tp"),),
+                  out_specs=P())
+    return f(x)  # line 16: DK123 wrong-rank in_specs vs rank-2 operand
+
+
+def bad_axis():
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P("model"),),
+                  out_specs=P())  # line 20: DK123 axis absent from mesh
+    return f
+
+
+def dup_axis():
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P(("dp", "dp")),),
+                  out_specs=P())  # line 26: DK123 duplicate axis in one spec
+    return f
+
+
+def good_divide():
+    x = jnp.zeros((6, 16))
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp", "tp"),),
+                  out_specs=P())
+    return f(x)  # NOT flagged: dp=2 divides 6, tp=4 divides 16
+
+
+def bad_divide():
+    x = jnp.zeros((7, 16))
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp", None),),
+                  out_specs=P())
+    return f(x)  # line 42: DK123 dp=2 provably does not divide 7
+
+
+def bad_arity(x, y):
+    f = shard_map(lambda a, b, c: a, mesh=MESH,
+                  in_specs=(P("dp"), P("dp"), P("dp")), out_specs=P())
+    return f(x, y)  # line 48: DK123 3 in_specs, 2 operands
+
+
+def good(x):
+    x = jnp.zeros((8, 128))
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp", "tp"),),
+                  out_specs=P("dp"))
+    g = shard_map(lambda a: a, mesh=MESH, in_specs=P("dp"), out_specs=P())
+    unresolved = shard_map(lambda a: a, mesh=MESH, in_specs=x.sharding.spec,
+                           out_specs=P())
+    return f(x), g(x), unresolved(x)  # no DK123: sound or unresolvable
+
+
+def suppressed():
+    f = shard_map(lambda a: a, mesh=MESH,  # dklint: disable=DK123
+                  in_specs=(P("nope"),), out_specs=P())
+    return f

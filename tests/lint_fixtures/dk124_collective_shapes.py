@@ -1,0 +1,46 @@
+"""DK124 fixture: collective shape/axis arithmetic.  Parsed only."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+MESH = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+
+
+def bad_gather_dim(x):
+    y = jnp.ones((4, 8))
+    return lax.all_gather(y, "dp", axis=3, tiled=True)  # line 14: DK124
+
+
+def bad_scatter_dim(x):
+    y = jnp.ones((4, 8))
+    return lax.psum_scatter(y, "dp", scatter_dimension=2)  # line 19: DK124
+
+
+def bad_scatter_divide(x):
+    y = jnp.ones((6, 8))
+    return lax.psum_scatter(y, "dp", scatter_dimension=0)  # line 24: DK124 4∤6
+
+
+def bad_perm_dup(x):
+    return lax.ppermute(x, "dp", perm=[(0, 1), (0, 2)])  # line 28: DK124
+
+
+def bad_perm_range(x):
+    return lax.ppermute(x, "dp", perm=[(0, 1), (1, 7)])  # line 32: DK124 7≥4
+
+
+def good(x):
+    y = jnp.ones((4, 8))
+    a = lax.all_gather(y, "dp", axis=1, tiled=True)  # NOT flagged
+    b = lax.all_gather(y, "dp", axis=2)  # NOT flagged: inserts new dim
+    c = lax.psum_scatter(y, "dp", scatter_dimension=0)  # NOT flagged: 4|4
+    d = lax.ppermute(x, "dp", perm=[(i, (i + 1) % 4) for i in range(4)])
+    e = lax.ppermute(x, "tp", perm=[(0, 1), (1, 0)])  # NOT flagged
+    return a, b, c, d, e
+
+
+def suppressed(x):
+    return lax.ppermute(x, "dp", perm=[(0, 0), (0, 0)])  # dklint: disable=DK124

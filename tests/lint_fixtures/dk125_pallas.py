@@ -1,0 +1,114 @@
+"""DK125 fixture: Pallas kernel contracts.  Parsed only."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _acc_kernel(x_ref, o_ref, acc_ref, *, block_q):
+    acc_ref[...] += x_ref[...]
+    o_ref[...] = acc_ref[...].astype(jnp.float16)  # line 17: DK125 dtype
+
+
+def bad_block_divide():
+    x = jnp.zeros((8, 100), jnp.float32)
+    return pl.pallas_call(  # line 22: DK125 32 does not divide 100
+        _copy_kernel,
+        grid=(8, 4),
+        in_specs=[pl.BlockSpec((1, 32), lambda b, i: (b, i))],
+        out_specs=pl.BlockSpec((1, 32), lambda b, i: (b, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 100), jnp.float32),
+    )(x)
+
+
+def bad_coverage():
+    x = jnp.zeros((8, 128), jnp.float32)
+    return pl.pallas_call(  # line 33: DK125 grid 2 x block 32 != 128
+        _copy_kernel,
+        grid=(8, 2),
+        in_specs=[pl.BlockSpec((1, 32), lambda b, i: (b, i))],
+        out_specs=pl.BlockSpec((1, 32), lambda b, i: (b, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(x)
+
+
+def bad_arity():
+    x = jnp.zeros((8, 128), jnp.float32)
+    return pl.pallas_call(  # line 44: DK125 kernel wants 3 refs, gets 2
+        functools.partial(_acc_kernel, block_q=32),
+        grid=(8, 4),
+        in_specs=[pl.BlockSpec((1, 32), lambda b, i: (b, i))],
+        out_specs=pl.BlockSpec((1, 32), lambda b, i: (b, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float16),
+    )(x)
+
+
+def bad_out_pairing():
+    x = jnp.zeros((8, 128), jnp.float32)
+    return pl.pallas_call(  # line 55: DK125 2 out_specs, 1 out_shape
+        _copy_kernel,
+        grid=(8, 4),
+        in_specs=[pl.BlockSpec((1, 32), lambda b, i: (b, i))],
+        out_specs=[pl.BlockSpec((1, 32), lambda b, i: (b, i)),
+                   pl.BlockSpec((1, 32), lambda b, i: (b, i))],
+        out_shape=[jax.ShapeDtypeStruct((8, 128), jnp.float32)],
+    )(x)
+
+
+def bad_rank():
+    x = jnp.zeros((8, 128), jnp.float32)
+    return pl.pallas_call(  # line 67: DK125 rank-3 block vs rank-2 array
+        _copy_kernel,
+        grid=(8, 4),
+        in_specs=[pl.BlockSpec((1, 32, 4), lambda b, i: (b, i, 0))],
+        out_specs=pl.BlockSpec((1, 32), lambda b, i: (b, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(x)
+
+
+def bad_store_dtype():
+    x = jnp.zeros((8, 128), jnp.float32)
+    return pl.pallas_call(  # dtype finding fires at the kernel store line
+        functools.partial(_acc_kernel, block_q=32),
+        grid=(8, 4),
+        in_specs=[pl.BlockSpec((1, 32), lambda b, i: (b, i))],
+        out_specs=pl.BlockSpec((1, 32), lambda b, i: (b, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, 32), jnp.float32)],
+    )(x)
+
+
+def good_flash_style():
+    x = jnp.zeros((4, 128, 64), jnp.float32)
+    scratch = pltpu.VMEM((128, 64), jnp.float32)
+    out = pl.pallas_call(  # NOT flagged: tiles divide, grid covers, arity ok
+        functools.partial(_acc3_kernel, block_q=128),
+        grid=(4, 1, 2),
+        in_specs=[pl.BlockSpec((1, 128, 32), lambda b, i, j: (b, i, j))],
+        out_specs=pl.BlockSpec((1, 128, 32), lambda b, i, j: (b, i, j)),
+        out_shape=jax.ShapeDtypeStruct((4, 128, 64), jnp.float32),
+        scratch_shapes=[scratch],
+    )(x)
+    return out
+
+
+def _acc3_kernel(x_ref, o_ref, acc_ref, *, block_q):
+    o_ref[...] = x_ref[...].astype(jnp.float32)  # NOT flagged: dtype agrees
+
+
+def good_unresolvable(x):
+    bq = x.shape[-1]
+    return pl.pallas_call(  # NOT flagged: block/grid symbolic
+        _copy_kernel,
+        grid=(x.shape[0], bq // 32),
+        in_specs=[pl.BlockSpec((1, 32), lambda b, i: (b, i))],
+        out_specs=pl.BlockSpec((1, 32), lambda b, i: (b, i)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,47 @@
+"""DK126 fixture: producer/consumer sharding drift.  Parsed only."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+MESH = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+
+
+def drift(x):
+    x = jax.device_put(jnp.zeros((8, 8)), NamedSharding(MESH, P("dp")))
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P(None, "tp"),),
+                  out_specs=P())
+    return f(x)  # line 16: DK126 producer dp vs consumer tp
+
+
+def drift_constraint(x):
+    y = jax.lax.with_sharding_constraint(x, NamedSharding(MESH, P("tp")))
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp"),), out_specs=P())
+    return f(y)  # line 22: DK126 producer tp vs consumer dp
+
+
+def agree(x):
+    x = jax.device_put(jnp.zeros((8, 8)), NamedSharding(MESH, P("dp")))
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp", None),),
+                  out_specs=P())
+    return f(x)  # NOT flagged: same axis set
+
+
+def replicated_in(x):
+    x = jax.device_put(jnp.zeros((8, 8)), NamedSharding(MESH, P()))
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp"),), out_specs=P())
+    return f(x)  # NOT flagged: replicated producer entering a mesh is normal
+
+
+def jit_drift(x):
+    x = jax.device_put(jnp.zeros((8, 8)), NamedSharding(MESH, P("dp")))
+    f = jax.jit(lambda a: a, in_shardings=(NamedSharding(MESH, P("tp")),))
+    return f(x)  # line 41: DK126 jit in_shardings partitions tp, value dp
+
+
+def suppressed(x):
+    x = jax.device_put(jnp.zeros((8, 8)), NamedSharding(MESH, P("dp")))
+    f = shard_map(lambda a: a, mesh=MESH, in_specs=(P("tp"),), out_specs=P())
+    return f(x)  # dklint: disable=DK126

@@ -723,7 +723,7 @@ def test_all_rules_registered():
         "DK101", "DK102", "DK103", "DK104", "DK105", "DK106", "DK107",
         "DK108", "DK109", "DK110", "DK111", "DK112", "DK113", "DK114",
         "DK115", "DK116", "DK117", "DK118", "DK119", "DK120", "DK121",
-        "DK122",
+        "DK122", "DK123", "DK124", "DK125", "DK126",
     ]
 
 
@@ -1008,3 +1008,191 @@ def test_cli_since_bad_ref_is_usage_error(tmp_path):
     )
     assert out.returncode == 2
     assert "--since" in out.stderr
+
+
+# ------------------------------------------------- DK123–DK126 shape rules
+
+def test_dk123_shard_spec_fixture():
+    got, _ = _run("dk123_shard_specs.py", ["DK123"])
+    assert got == [
+        ("DK123", 16),  # wrong-rank in_specs vs rank-2 operand
+        ("DK123", 20),  # axis absent from governing mesh
+        ("DK123", 26),  # duplicate axis in one PartitionSpec
+        ("DK123", 42),  # dp=2 provably does not divide 7
+        ("DK123", 48),  # 3 in_specs entries, 2 operands
+    ]
+
+
+def test_dk123_no_fp_and_suppression():
+    got, _ = _run("dk123_shard_specs.py", ["DK123"])
+    lines = [ln for _, ln in got]
+    assert 35 not in lines  # sound specs: dp|6, tp|16
+    assert 56 not in lines  # single-spec pytree prefix is legal
+    assert 62 not in lines  # trailing disable directive
+    assert 63 not in lines
+
+
+def test_dk123_compat_partial_manual_fixture():
+    """The jax<0.5 shim's NotImplementedError, statically (satellite: the
+    pipeline x tensor-parallel composition documented in CHANGES PR 1)."""
+    got, _ = _run("dk123_compat_partial.py", ["DK123"])
+    assert got == [
+        ("DK123", 14),  # axis_names strict subset of mesh axes
+        ("DK123", 37),  # compat path runs the same axis checks as direct
+        ("DK123", 44),  # ... including through an import alias
+    ]
+
+
+def test_dk123_nested_mapper_shadowed_axis():
+    """shard_map under vmap with a shadowed axis name: the vmap binding
+    must not confuse the mesh judgement in either direction, and
+    compat.shard_map resolves to the same judgement as direct shard_map."""
+    got, _ = _run("dk123_nested_mappers.py", ["DK123"])
+    assert got == [
+        ("DK123", 35),  # bad spec is still flagged under the shadow
+        ("DK123", 48),  # direct shard_map: wrong-rank
+        ("DK123", 48),  # compat.shard_map: same finding, same line
+    ]
+    # the sound nested case (vmap axis_name == mesh axis) stays silent
+    assert all(ln > 30 for _, ln in got)
+
+
+def test_dk123_nested_mapper_dk108_interplay():
+    """DK108 must still accept the collective inside the nested mapper —
+    the axis is bound by both the mesh and the vmap."""
+    got, _ = _run("dk123_nested_mappers.py", ["DK108"])
+    assert got == []
+
+
+def test_dk124_collective_shapes_fixture():
+    got, _ = _run("dk124_collective_shapes.py", ["DK124"])
+    assert got == [
+        ("DK124", 14),  # all_gather dim index out of range
+        ("DK124", 19),  # psum_scatter dim index out of range
+        ("DK124", 24),  # axis size 4 does not divide scattered dim 6
+        ("DK124", 28),  # ppermute duplicate source
+        ("DK124", 32),  # ppermute index outside axis size
+    ]
+
+
+def test_dk124_no_fp_and_suppression():
+    got, _ = _run("dk124_collective_shapes.py", ["DK124"])
+    lines = [ln for _, ln in got]
+    for good_line in (37, 38, 39, 40, 41, 46):
+        assert good_line not in lines
+
+
+def test_dk124_same_module_axis_size_conflict(tmp_path):
+    """Two literal mesh constructions sizing the same axis differently in
+    one (non-test) module is the cross-engine size-conflict smell."""
+    mod = tmp_path / "sizes.py"
+    mod.write_text(
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "\n"
+        "A = Mesh(np.array(jax.devices()).reshape(4, 2), ('dp', 'tp'))\n"
+        "B = Mesh(np.array(jax.devices()).reshape(2, 4), ('dp', 'tp'))\n"
+    )
+    findings, _ = analyze([str(mod)], root=str(tmp_path), select=["DK124"])
+    assert [(f.rule, f.line) for f in findings] == [
+        ("DK124", 5),  # anchored on the first construction of the axis
+        ("DK124", 5),  # once per conflicted axis (dp and tp)
+    ]
+
+
+def test_dk125_pallas_fixture():
+    got, _ = _run("dk125_pallas.py", ["DK125"])
+    assert got == [
+        ("DK125", 17),  # kernel stores float16, out_shape says float32
+        ("DK125", 22),  # in_specs block does not divide dim
+        ("DK125", 22),  # ... and out_specs likewise
+        ("DK125", 33),  # grid x block covers 64 of 128 (in_specs)
+        ("DK125", 33),  # ... and out_specs likewise
+        ("DK125", 44),  # kernel arity vs in+out+scratch refs
+        ("DK125", 55),  # out_specs / out_shape pairing
+        ("DK125", 67),  # block rank vs array rank
+    ]
+
+
+def test_dk125_no_fp():
+    got, _ = _run("dk125_pallas.py", ["DK125"])
+    lines = [ln for _, ln in got]
+    # the flash-attention-style sound call and the symbolic one stay silent
+    assert all(ln <= 67 for ln in lines), lines
+
+
+def test_dk126_sharding_drift_fixture():
+    got, _ = _run("dk126_sharding_drift.py", ["DK126"])
+    assert got == [
+        ("DK126", 16),  # device_put P('dp') into shard_map P(None,'tp')
+        ("DK126", 22),  # with_sharding_constraint P('tp') into P('dp')
+        ("DK126", 41),  # jit in_shardings drift
+    ]
+
+
+def test_dk126_no_fp_and_suppression():
+    got, _ = _run("dk126_sharding_drift.py", ["DK126"])
+    lines = [ln for _, ln in got]
+    assert 30 not in lines  # same axis set: no drift
+    assert 36 not in lines  # replicated producer entering a mesh is normal
+    assert 47 not in lines  # trailing disable directive
+
+
+def test_shapes_report_cli():
+    """--shapes-report emits the per-engine layout table: engine buckets,
+    shard_map rows with resolved specs, deterministic output."""
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", "distkeras_tpu",
+         "--root", REPO_ROOT, "--shapes-report"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "dkshape layout report" in out.stdout
+    for bucket in ("engine", "gspmd", "pipeline", "serving"):
+        assert f"==== {bucket} ====" in out.stdout
+    assert "shard_map[compat]" in out.stdout
+    assert "pallas_call" in out.stdout
+    # deterministic: a second run is byte-identical (report is an artifact)
+    again = subprocess.run(
+        [sys.executable, "-m", "tools.dklint", "distkeras_tpu",
+         "--root", REPO_ROOT, "--shapes-report"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert again.stdout == out.stdout
+
+
+def test_cli_stale_warning_in_every_format_and_select_scoped(tmp_path):
+    """CI greps the --format github legs for "stale baseline entry", so
+    the warning must reach stderr in non-text formats too; a --select
+    run must NOT call other rules' entries stale (it produced no
+    findings for them, so their staleness is undecidable)."""
+    src = "import jax\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n"
+    (tmp_path / "mod.py").write_text(src)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({
+        "version": 1,
+        "findings": [
+            {"path": "mod.py", "rule": "DK102",
+             "text": "this line is long gone", "reason": "stale"},
+        ],
+    }))
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.dklint", "mod.py",
+             "--root", str(tmp_path), "--baseline", str(baseline), *extra],
+            cwd=tmp_path, env=env, capture_output=True, text=True,
+        )
+
+    for fmt in ("github", "sarif", "json", "text"):
+        got = run("--format", fmt)
+        assert "stale baseline entry" in got.stderr, (fmt, got.stderr)
+    # DK101 selected: the DK102 entry's staleness is out of scope
+    scoped = run("--select", "DK101")
+    assert "stale baseline entry" not in scoped.stderr, scoped.stderr
+    # ...but a select that covers the entry's rule still reports it
+    covered = run("--select", "DK102")
+    assert "stale baseline entry" in covered.stderr, covered.stderr

@@ -1,0 +1,371 @@
+"""Unit tests for the dkshape abstract interpreter (tools/dklint/shapes.py):
+the symbolic dim domain, demand-driven expression evaluation, mesh/spec
+modeling, collective shape semantics, and interprocedural parameter
+binding.  Pure AST work — no jax import, no devices."""
+
+import ast
+import os
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.dklint.core import Project, load_file  # noqa: E402
+from tools.dklint.shapes import (  # noqa: E402
+    UNKNOWN,
+    ArrayVal,
+    Dim,
+    Evaluator,
+    MeshVal,
+    ShardingVal,
+    SpecVal,
+    axis_sym,
+    dim_add,
+    dim_floordiv,
+    dim_mul,
+    dim_of,
+    dim_sub,
+    layout_report,
+    param_bindings,
+    provably_not_divides,
+    render_value,
+    shard_map_sites,
+)
+
+
+# ------------------------------------------------------------------ helpers
+
+def _project(tmp_path, src, name="mod_under_test.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(src))
+    fi = load_file(str(path), str(tmp_path))
+    return Project(str(tmp_path), [fi]), fi
+
+
+def _fn(fi, name):
+    return next(
+        n for n in ast.walk(fi.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name == name
+    )
+
+
+def _eval_ret(tmp_path, src, fn_name="f"):
+    """Evaluate the expression returned by ``fn_name`` in ``src``."""
+    project, fi = _project(tmp_path, src)
+    fn = _fn(fi, fn_name)
+    ret = next(n for n in ast.walk(fn) if isinstance(n, ast.Return))
+    return Evaluator(project, fi, fn).eval(ret.value)
+
+
+PRELUDE = """\
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+"""
+
+
+# ---------------------------------------------------------------- dim domain
+
+def test_dim_of_lifts_ints_only():
+    assert dim_of(3) == Dim(3)
+    assert dim_of(Dim(2, ("ax$dp",))) == Dim(2, ("ax$dp",))
+    assert dim_of(True) is None     # bool is an int but never a shape dim
+    assert dim_of("dp") is None
+    assert dim_of(None) is None
+
+
+def test_dim_repr_and_axis_sym():
+    assert repr(Dim(7)) == "7"
+    assert repr(axis_sym("dp")) == "ax$dp"
+    assert repr(dim_mul(Dim(2), axis_sym("dp"))) == "2*ax$dp"
+    assert axis_sym("dp") == Dim(1, ("ax$dp",))
+
+
+def test_dim_linear_arithmetic():
+    dp = axis_sym("dp")
+    assert dim_add(Dim(2), Dim(3)) == Dim(5)
+    assert dim_add(dp, dp) == Dim(2, ("ax$dp",))
+    # unlike symbols don't combine: the sum is unknown, not a guess
+    assert dim_add(dp, axis_sym("tp")) is None
+    assert dim_sub(Dim(10), Dim(4)) == Dim(6)
+    assert dim_mul(Dim(4), dp) == Dim(4, ("ax$dp",))
+    assert dim_mul(None, Dim(2)) is None
+
+
+def test_dim_floordiv_is_exact_only():
+    dp = axis_sym("dp")
+    assert dim_floordiv(Dim(8), Dim(2)) == Dim(4)
+    assert dim_floordiv(Dim(7), Dim(2)) is None          # lossy -> unknown
+    assert dim_floordiv(dim_mul(Dim(6), dp), dp) == Dim(6)
+    assert dim_floordiv(dp, axis_sym("tp")) is None
+    assert dim_floordiv(Dim(8), Dim(0)) is None
+
+
+def test_provably_not_divides_needs_concrete_dims():
+    assert provably_not_divides(4, Dim(6))
+    assert not provably_not_divides(4, Dim(8))
+    # a symbolic factor could absorb anything — never provable
+    assert not provably_not_divides(4, dim_mul(Dim(6), axis_sym("dp")))
+    assert not provably_not_divides(0, Dim(6))
+
+
+# ----------------------------------------------------------------- evaluator
+
+def test_eval_array_constructors(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        return jnp.zeros((4, 8), jnp.float32)
+    """)
+    assert got == ArrayVal((Dim(4), Dim(8)), "float32")
+
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        return jnp.arange(10)
+    """)
+    assert got == ArrayVal((Dim(10),))
+
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        x = jnp.ones((2, 3), jnp.bfloat16)
+        return jnp.zeros_like(x)
+    """)
+    assert got == ArrayVal((Dim(2), Dim(3)), "bfloat16")
+
+
+def test_eval_module_level_assign_resolves_as_free_var(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    X = jnp.zeros((4, 8))
+
+    def f():
+        return X
+    """)
+    assert got == ArrayVal((Dim(4), Dim(8)))
+
+
+def test_eval_reshape_infers_minus_one(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        x = jnp.zeros((4, 8))
+        return x.reshape(2, -1)
+    """)
+    assert got == ArrayVal((Dim(2), Dim(16)))
+
+
+def test_eval_structural_ops(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        x = jnp.zeros((4, 8))
+        return x.T
+    """)
+    assert got == ArrayVal((Dim(8), Dim(4)))
+
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        a = jnp.zeros((4, 8))
+        b = jnp.zeros((2, 8))
+        return jnp.concatenate([a, b], axis=0)
+    """)
+    assert got == ArrayVal((Dim(6), Dim(8)))
+
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        x = jnp.zeros((4, 8))
+        return jnp.sum(x, axis=1)
+    """)
+    assert got == ArrayVal((Dim(4),))
+
+
+def test_eval_unresolvable_is_unknown_not_guess(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f(batch):
+        return jnp.zeros((batch, 8))
+    """)
+    # free param with no call sites: the dim is unknown, the rank is not
+    assert isinstance(got, ArrayVal)
+    assert got.shape == (None, Dim(8))
+
+
+def test_eval_mesh_ctor_recovers_reshape_dims(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        return Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+    """)
+    assert got == MeshVal((("dp", 2), ("tp", 4)))
+    assert got.size_of("tp") == 4
+    assert got.size_of("model") is None
+
+
+def test_eval_partition_spec_entries(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        return P("dp", None, ("a", "b"))
+    """)
+    assert got == SpecVal((("dp",), (), ("a", "b")))
+    assert got.rank == 3
+    assert got.axis_names() == {"dp", "a", "b"}
+    assert repr(got) == "P('dp', None, ('a', 'b'))"
+
+
+def test_eval_named_sharding_attaches_to_device_put(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    MESH = Mesh(np.array(jax.devices()).reshape(8,), ("workers",))
+
+    def f():
+        x = jnp.zeros((16, 4))
+        return jax.device_put(x, NamedSharding(MESH, P("workers")))
+    """)
+    assert isinstance(got, ArrayVal)
+    assert got.shape == (Dim(16), Dim(4))
+    assert isinstance(got.sharding, ShardingVal)
+    assert got.sharding.spec == SpecVal((("workers",),))
+
+
+def test_eval_collective_shape_semantics(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        x = jnp.zeros((4, 8))
+        return lax.all_gather(x, "dp", axis=0, tiled=True)
+    """)
+    assert got == ArrayVal((dim_mul(Dim(4), axis_sym("dp")), Dim(8)))
+
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        x = jnp.zeros((4, 8))
+        return lax.all_gather(x, "dp", axis=1)
+    """)
+    assert got == ArrayVal((Dim(4), axis_sym("dp"), Dim(8)))
+
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        x = jnp.zeros((4, 8))
+        return lax.psum(x, "dp")
+    """)
+    assert got == ArrayVal((Dim(4), Dim(8)))
+
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        return lax.axis_size("dp")
+    """)
+    assert got == axis_sym("dp")
+
+
+def test_eval_symbolic_gather_then_scatter_round_trips(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f():
+        x = jnp.zeros((4, 8))
+        g = lax.all_gather(x, "dp", axis=0, tiled=True)
+        return lax.psum_scatter(g, "dp", scatter_dimension=0)
+    """)
+    # (4*ax$dp, 8) scattered over dp divides exactly back to (4, 8)
+    assert got == ArrayVal((Dim(4), Dim(8)))
+
+
+# ----------------------------------------------------------- interprocedural
+
+def test_param_binding_when_all_sites_agree(tmp_path):
+    project, fi = _project(tmp_path, textwrap.dedent(PRELUDE + """\
+    def inner(x):
+        return x
+
+    def a():
+        return inner(jnp.zeros((4, 8)))
+
+    def b():
+        return inner(jnp.zeros((4, 8)))
+    """))
+    got = param_bindings(project, fi, _fn(fi, "inner"))
+    assert got == {"x": ArrayVal((Dim(4), Dim(8)))}
+
+
+def test_param_binding_dropped_when_sites_conflict(tmp_path):
+    project, fi = _project(tmp_path, textwrap.dedent(PRELUDE + """\
+    def inner(x):
+        return x
+
+    def a():
+        return inner(jnp.zeros((4, 8)))
+
+    def b():
+        return inner(jnp.zeros((2, 2)))
+    """))
+    assert param_bindings(project, fi, _fn(fi, "inner")) == {}
+
+
+def test_param_binding_flows_into_evaluation(tmp_path):
+    got = _eval_ret(tmp_path, PRELUDE + """\
+    def f(x):
+        return x.shape
+
+    def caller():
+        return f(jnp.zeros((4, 8)))
+    """)
+    assert got == (Dim(4), Dim(8))
+
+
+# ------------------------------------------------------- sites & the report
+
+def test_shard_map_sites_via_detection(tmp_path):
+    project, fi = _project(tmp_path, textwrap.dedent("""\
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from distkeras_tpu.utils.compat import shard_map as compat_shard_map
+
+    MESH = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+
+    def direct(x):
+        return shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp"),),
+                         out_specs=P())(x)
+
+    def wrapped(x):
+        return compat_shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp"),),
+                                out_specs=P())(x)
+    """))
+    sites = shard_map_sites(project, fi)
+    assert sorted(s.via for s in sites) == ["compat", "jax"]
+    for site in sites:
+        assert site.mesh == MeshVal((("dp", 2), ("tp", 4)))
+        assert site.in_specs == (SpecVal((("dp",),)),)
+        assert site.invoke is not None
+
+
+def test_render_value_is_deterministic():
+    assert render_value(UNKNOWN) == "?"
+    assert render_value((Dim(2), axis_sym("dp"))) == "(2, ax$dp)"
+    text = render_value(ArrayVal((Dim(4), None), "float32"))
+    assert "0x" not in text  # no memory addresses in report output
+
+
+def test_layout_report_lists_resolved_sites(tmp_path):
+    src = textwrap.dedent("""\
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    MESH = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+
+    def f(x):
+        y = jax.device_put(x, NamedSharding(MESH, P("dp")))
+        return shard_map(lambda a: a, mesh=MESH, in_specs=(P("dp"),),
+                         out_specs=P())(y)
+    """)
+    (tmp_path / "mod_report.py").write_text(src)
+    report = layout_report([str(tmp_path / "mod_report.py")], str(tmp_path))
+    assert "dkshape layout report" in report
+    assert "shard_map[jax] mesh=Mesh{dp:2, tp:4}" in report
+    assert "device_put -> NamedSharding(Mesh{dp:2, tp:4}, P('dp'))" in report
+    # byte-identical on a second run — the report is a CI artifact
+    assert report == layout_report(
+        [str(tmp_path / "mod_report.py")], str(tmp_path))

@@ -1,0 +1,268 @@
+"""DK124 — collective shape/axis arithmetic, judged off-device.
+
+DK104 checks that a collective's *axis name* exists; DK108 checks it is
+bound by an enclosing mapper.  This rule checks the *arithmetic* the
+collective performs against the shape model:
+
+  * ``all_gather``/``psum_scatter`` with an ``axis=`` dim index that is
+    provably out of range for the operand's known rank — the scaling
+    lands on the wrong dim (or no dim at all);
+  * ``psum_scatter`` whose scattered dim is concrete and provably not
+    divisible by the known axis size;
+  * a literal ``ppermute`` permutation that is not a bijection over
+    ``axis_size`` — duplicate sources (two senders, one wins
+    silently), duplicate destinations, or indices outside a known axis
+    size;
+  * the same module constructing the same ``axis_name`` with two
+    different literal sizes — the cross-engine size-conflict smell
+    (engine code must agree with itself; distinct engines legitimately
+    size meshes differently, so the check is deliberately per-module).
+
+Axis sizes come from the abstract mesh model: a size is "known" only
+when every literal mesh construction that declares the axis (in the
+file, falling back to the whole analyzed tree) agrees on one value.
+Test modules (``test_*.py``) are exempt from the conflict check —
+constructing meshes of several sizes is what tests do.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.dklint import shapes
+from tools.dklint.core import Checker, FileInfo, Finding, Project
+from tools.dklint.registry import register
+from tools.dklint.shapes import ArrayVal, Evaluator, MeshVal
+
+MESH_CTOR_SHORTS = {"Mesh", "make_mesh", "make_mesh_grid"}
+
+SIZES_KEY = "DK124.axis_sizes"  # relpath -> {axis: {sizes}}
+
+
+def _is_test_module(relpath: str) -> bool:
+    return os.path.basename(relpath).startswith("test_") or \
+        "/lint_fixtures/" in relpath
+
+
+def _axis_name_of(ev: Evaluator, node: ast.Call) -> Optional[str]:
+    for kw in node.keywords:
+        if kw.arg == "axis_name":
+            got = ev.eval(kw.value)
+            return got if isinstance(got, str) else None
+    if len(node.args) >= 2:
+        got = ev.eval(node.args[1])
+        return got if isinstance(got, str) else None
+    return None
+
+
+@register
+class CollectiveShapeChecker(Checker):
+    rule = "DK124"
+    name = "collective-shape-arithmetic"
+    description = (
+        "collective shape arithmetic provably wrong: all_gather/"
+        "psum_scatter dim index out of range, non-divisible psum_scatter "
+        "dim, ppermute permutation that is not a bijection over the axis "
+        "size, or one module sizing the same mesh axis two ways"
+    )
+
+    # ---------------------------------------------------------------- pass 1
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        shapes.collect_facts(project, fi)
+        table: Dict[str, Dict[str, Set[int]]] = project.data.setdefault(
+            SIZES_KEY, {}
+        )
+        per_file: Dict[str, Set[int]] = table.setdefault(fi.relpath, {})
+        facts = shapes._facts_for(project, fi)
+        for call, encl in facts.calls:
+            _resolved, short = shapes.resolved_call(fi, call)
+            if short not in MESH_CTOR_SHORTS:
+                continue
+            got = Evaluator(project, fi, encl).eval(call)
+            if isinstance(got, MeshVal):
+                for axis, size in got.axes:
+                    if size is not None:
+                        per_file.setdefault(axis, set()).add(size)
+
+    # ------------------------------------------------------------- axis size
+    def _known_axis_size(self, project: Project, fi: FileInfo,
+                         axis: str) -> Optional[int]:
+        table: Dict[str, Dict[str, Set[int]]] = project.data.get(SIZES_KEY, {})
+        local = table.get(fi.relpath, {}).get(axis, set())
+        if len(local) == 1:
+            return next(iter(local))
+        if local:
+            return None  # conflicting in-file sizes: nothing is provable
+        everywhere: Set[int] = set()
+        for relpath, axes in table.items():
+            if _is_test_module(relpath):
+                continue
+            everywhere |= axes.get(axis, set())
+        if len(everywhere) == 1:
+            return next(iter(everywhere))
+        return None
+
+    # ---------------------------------------------------------------- pass 2
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        yield from self._check_size_conflicts(project, fi)
+        facts = shapes._facts_for(project, fi)
+        for call, encl in facts.calls:
+            _resolved, short = shapes.resolved_call(fi, call)
+            if short not in ("all_gather", "psum_scatter", "ppermute"):
+                continue
+            ev = Evaluator(project, fi, encl)
+            if short == "ppermute":
+                yield from self._check_ppermute(project, fi, ev, call)
+            else:
+                yield from self._check_gather_scatter(project, fi, ev, call,
+                                                      short)
+
+    def _check_size_conflicts(self, project: Project,
+                              fi: FileInfo) -> Iterable[Finding]:
+        if _is_test_module(fi.relpath):
+            return
+        table: Dict[str, Dict[str, Set[int]]] = project.data.get(SIZES_KEY, {})
+        conflicted = sorted(
+            (axis, sorted(sizes))
+            for axis, sizes in table.get(fi.relpath, {}).items()
+            if len(sizes) > 1
+        )
+        if not conflicted:
+            return
+        facts = shapes._facts_for(project, fi)
+        for axis, sizes in conflicted:
+            # anchor the finding on the first construction naming the axis
+            for call, encl in facts.calls:
+                _resolved, short = shapes.resolved_call(fi, call)
+                if short not in MESH_CTOR_SHORTS:
+                    continue
+                got = Evaluator(project, fi, encl).eval(call)
+                if isinstance(got, MeshVal) and axis in got.names:
+                    yield Finding(
+                        path=fi.relpath, line=call.lineno,
+                        col=call.col_offset, rule=self.rule,
+                        message=(
+                            f"mesh axis '{axis}' is constructed with "
+                            f"conflicting literal sizes {sizes} in this "
+                            "module — collectives over it cannot be sized "
+                            "consistently"
+                        ),
+                    )
+                    break
+
+    def _check_gather_scatter(self, project: Project, fi: FileInfo,
+                              ev: Evaluator, call: ast.Call,
+                              short: str) -> Iterable[Finding]:
+        operand = ev.eval(call.args[0]) if call.args else None
+        dim_idx: object = 0
+        for kw in call.keywords:
+            if kw.arg == "axis" or (
+                short == "psum_scatter" and kw.arg == "scatter_dimension"
+            ):
+                dim_idx = ev.eval(kw.value)
+        if not isinstance(operand, ArrayVal) or operand.shape is None or \
+                not isinstance(dim_idx, int):
+            return
+        rank = len(operand.shape)
+        # all_gather without tiled= inserts a new dim, so `rank` itself is
+        # a legal position there; everything past it never is
+        limit = rank if short == "all_gather" else rank - 1
+        tiled = False
+        for kw in call.keywords:
+            if kw.arg == "tiled" and ev.eval(kw.value) is True:
+                tiled = True
+        if tiled:
+            limit = rank - 1
+        if dim_idx < 0 or dim_idx > limit:
+            yield Finding(
+                path=fi.relpath, line=call.lineno, col=call.col_offset,
+                rule=self.rule,
+                message=(
+                    f"{short} axis={dim_idx} is out of range for operand "
+                    f"rank {rank} ({operand!r}) — the "
+                    f"{'gather' if short == 'all_gather' else 'scatter'} "
+                    "scaling cannot land on any dim"
+                ),
+            )
+            return
+        if short == "psum_scatter":
+            axis_name = shapes._collective_axis(ev, call)
+            if not isinstance(axis_name, str):
+                return
+            size = self._known_axis_size(project, fi, axis_name)
+            dim = operand.shape[dim_idx]
+            if size is not None and size > 1 and dim is not None and \
+                    dim.is_int and dim.coeff % size != 0:
+                yield Finding(
+                    path=fi.relpath, line=call.lineno, col=call.col_offset,
+                    rule=self.rule,
+                    message=(
+                        f"psum_scatter over axis '{axis_name}' (size "
+                        f"{size}) scatters dim {dim_idx} of size "
+                        f"{dim.coeff}, which {size} does not divide"
+                    ),
+                )
+
+    def _check_ppermute(self, project: Project, fi: FileInfo,
+                        ev: Evaluator, call: ast.Call) -> Iterable[Finding]:
+        axis_name = shapes._collective_axis(ev, call)
+        perm_expr: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg == "perm":
+                perm_expr = kw.value
+        if perm_expr is None and len(call.args) >= 3:
+            perm_expr = call.args[2]
+        if perm_expr is None:
+            return
+        pairs = self._literal_pairs(ev, perm_expr)
+        if pairs is None:
+            return
+        srcs = [s for s, _d in pairs]
+        dsts = [d for _s, d in pairs]
+        dupes = sorted(
+            {f"source {s}" for s in srcs if srcs.count(s) > 1}
+            | {f"destination {d}" for d in dsts if dsts.count(d) > 1}
+        )
+        if dupes:
+            yield Finding(
+                path=fi.relpath, line=call.lineno, col=call.col_offset,
+                rule=self.rule,
+                message=(
+                    "ppermute perm is not a bijection: duplicate "
+                    + ", ".join(dupes)
+                ),
+            )
+        if isinstance(axis_name, str):
+            size = self._known_axis_size(project, fi, axis_name)
+            if size is not None:
+                bad = sorted({
+                    i for i in srcs + dsts if not (0 <= i < size)
+                })
+                if bad:
+                    yield Finding(
+                        path=fi.relpath, line=call.lineno,
+                        col=call.col_offset, rule=self.rule,
+                        message=(
+                            f"ppermute perm indices {bad} are outside "
+                            f"axis '{axis_name}' of size {size}"
+                        ),
+                    )
+
+    def _literal_pairs(self, ev: Evaluator,
+                       expr: ast.AST) -> Optional[List[Tuple[int, int]]]:
+        """Fully-literal ``[(src, dst), ...]``; None when any part is
+        dynamic (comprehensions over axis_size etc. are trusted)."""
+        got = ev.eval(expr)
+        if not isinstance(got, tuple):
+            return None
+        out: List[Tuple[int, int]] = []
+        for item in got:
+            if not (
+                isinstance(item, tuple) and len(item) == 2
+                and all(isinstance(x, int) for x in item)
+            ):
+                return None
+            out.append((item[0], item[1]))
+        return out

@@ -1,0 +1,292 @@
+"""DK125 — Pallas kernel contracts, grounded in ops/pallas/flash_attention.py.
+
+A ``pl.pallas_call`` is three contracts that nothing checks until the
+kernel runs on a TPU we have not had since r03:
+
+  * **arity** — the kernel function takes exactly one ref per in_spec,
+    per out_spec, and per scratch shape (keyword-only args bound via
+    ``functools.partial`` excluded), and ``in_specs`` matches the
+    operand count at the invocation;
+  * **tiling** — each BlockSpec's block rank matches the operand rank,
+    and every concrete block dim divides the concrete array dim (Pallas
+    pads the tail block; a kernel with no masking reads/writes garbage
+    there, so a non-dividing block with no provable mask is flagged);
+  * **coverage & stores** — for index_maps in the flash-attention idiom
+    (``lambda b, i, j: (b, i, 0)``: each output term a grid variable or
+    the constant 0), ``grid[g] × block`` must cover the dim exactly and
+    a constant-0 term must mean "this dim fits in one block"; the
+    ``out_shape`` list must pair 1:1 with ``out_specs``; and a kernel
+    store ``o_ref[...] = x.astype(dt)`` with a literal dtype must agree
+    with the declared ``out_shape`` dtype.
+
+Unresolvable kernels/specs/shapes are trusted (DK104/DK108 stance).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from tools.dklint import shapes
+from tools.dklint.core import Checker, FileInfo, Finding, Project
+from tools.dklint.registry import register
+from tools.dklint.shapes import (
+    UNKNOWN, ArrayVal, BlockSpecVal, Dim, Evaluator, FnVal, ShapeDtypeVal,
+    dim_mul,
+)
+
+
+def _as_list(value) -> Optional[List[object]]:
+    """out_specs / out_shape / scratch_shapes may be one object or a
+    tuple/list of them; None when unresolvable."""
+    if value is UNKNOWN or value is None:
+        return None
+    if isinstance(value, tuple):
+        return list(value)
+    return [value]
+
+
+@register
+class PallasContractChecker(Checker):
+    rule = "DK125"
+    name = "pallas-kernel-contracts"
+    description = (
+        "pallas_call contract provably broken: kernel ref arity vs "
+        "in_specs/out_specs/scratch_shapes, BlockSpec rank or non-dividing "
+        "block dim vs the operand, grid x block not covering a dim, "
+        "out_shape/out_specs pairing, or a kernel store dtype that "
+        "contradicts out_shape"
+    )
+
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        shapes.collect_facts(project, fi)
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        for site in shapes.pallas_sites(project, fi):
+            yield from self._check_site(project, fi, site)
+
+    # ------------------------------------------------------------------ site
+
+    def _check_site(self, project: Project, fi: FileInfo,
+                    site: shapes.PallasSite) -> Iterable[Finding]:
+        call = site.call
+        in_specs = _as_list(site.in_specs) if isinstance(
+            site.in_specs, (tuple, BlockSpecVal)
+        ) else None
+        out_specs = _as_list(site.out_specs) if isinstance(
+            site.out_specs, (tuple, BlockSpecVal)
+        ) else None
+        out_shape = _as_list(site.out_shape) if isinstance(
+            site.out_shape, (tuple, ShapeDtypeVal)
+        ) else None
+        scratch = _as_list(site.scratch) if site.scratch is not None else []
+        grid = site.grid if isinstance(site.grid, tuple) else None
+
+        if isinstance(site.out_specs, tuple) and \
+                isinstance(site.out_shape, tuple) and \
+                len(out_specs) != len(out_shape):
+            yield Finding(
+                path=fi.relpath, line=call.lineno, col=call.col_offset,
+                rule=self.rule,
+                message=(
+                    f"out_specs has {len(out_specs)} BlockSpecs but "
+                    f"out_shape declares {len(out_shape)} outputs"
+                ),
+            )
+
+        # kernel ref arity
+        if isinstance(site.kernel, FnVal) and in_specs is not None and \
+                out_shape is not None and scratch is not None:
+            expected = len(in_specs) + len(out_shape) + len(scratch)
+            got = site.kernel.positional_arity()
+            if got != expected:
+                yield Finding(
+                    path=fi.relpath, line=call.lineno, col=call.col_offset,
+                    rule=self.rule,
+                    message=(
+                        f"kernel takes {got} positional refs but "
+                        f"pallas_call provides {expected} "
+                        f"({len(in_specs)} in + {len(out_shape)} out + "
+                        f"{len(scratch)} scratch)"
+                    ),
+                )
+
+        # operand count and per-operand tiling
+        operand_shapes: List[Optional[Tuple[Optional[Dim], ...]]] = []
+        if site.invoke is not None and not any(
+            isinstance(a, ast.Starred) for a in site.invoke.args
+        ) and not site.invoke.keywords:
+            operands = list(site.invoke.args)
+            if in_specs is not None and len(in_specs) != len(operands):
+                yield Finding(
+                    path=fi.relpath, line=site.invoke.lineno,
+                    col=site.invoke.col_offset, rule=self.rule,
+                    message=(
+                        f"pallas_call in_specs has {len(in_specs)} "
+                        f"BlockSpecs but the kernel is invoked with "
+                        f"{len(operands)} operands"
+                    ),
+                )
+            else:
+                facts = shapes._facts_for(project, fi)
+                ev = Evaluator(project, fi, facts.encl.get(id(site.invoke)))
+                for operand in operands:
+                    got = ev.eval(operand)
+                    operand_shapes.append(
+                        got.shape if isinstance(got, ArrayVal) else None
+                    )
+
+        if in_specs is not None and operand_shapes:
+            for i, (spec, shape) in enumerate(zip(in_specs, operand_shapes)):
+                if isinstance(spec, BlockSpecVal) and shape is not None:
+                    yield from self._check_tiling(
+                        fi, call, spec, shape, grid, f"in_specs[{i}]"
+                    )
+
+        # outputs: block vs declared out_shape
+        if out_specs is not None and out_shape is not None and \
+                len(out_specs) == len(out_shape):
+            for j, (spec, decl) in enumerate(zip(out_specs, out_shape)):
+                if isinstance(spec, BlockSpecVal) and \
+                        isinstance(decl, ShapeDtypeVal) and \
+                        decl.shape is not None:
+                    yield from self._check_tiling(
+                        fi, call, spec, decl.shape, grid, f"out_specs[{j}]"
+                    )
+
+        # kernel store dtype vs out_shape dtype
+        if isinstance(site.kernel, FnVal) and in_specs is not None and \
+                out_shape is not None and scratch is not None:
+            yield from self._check_store_dtypes(
+                fi, call, site.kernel, len(in_specs), out_shape
+            )
+
+    # ---------------------------------------------------------------- tiling
+
+    def _check_tiling(self, fi: FileInfo, call: ast.Call, spec: BlockSpecVal,
+                      shape: Sequence[Optional[Dim]],
+                      grid: Optional[Tuple],
+                      where: str) -> Iterable[Finding]:
+        if spec.block is None:
+            return
+        if len(spec.block) != len(shape):
+            yield Finding(
+                path=fi.relpath, line=call.lineno, col=call.col_offset,
+                rule=self.rule,
+                message=(
+                    f"{where} block has rank {len(spec.block)} but the "
+                    f"array has rank {len(shape)}"
+                ),
+            )
+            return
+        divides_ok = [True] * len(shape)
+        for k, (b, d) in enumerate(zip(spec.block, shape)):
+            if b is None or d is None or not b.is_int or not d.is_int:
+                continue
+            if b.coeff > 0 and d.coeff % b.coeff != 0:
+                divides_ok[k] = False
+                yield Finding(
+                    path=fi.relpath, line=call.lineno, col=call.col_offset,
+                    rule=self.rule,
+                    message=(
+                        f"{where} block dim {k} = {b.coeff} does not "
+                        f"divide array dim {d.coeff} — the tail block is "
+                        "padded and nothing in the BlockSpec masks it"
+                    ),
+                )
+        # grid coverage, flash-attention idiom index_maps only
+        if grid is None or spec.index_map is None:
+            return
+        lam = spec.index_map
+        params = [a.arg for a in lam.args.posonlyargs + lam.args.args]
+        if len(params) != len(grid):
+            return
+        body = lam.body
+        elts = list(body.elts) if isinstance(body, ast.Tuple) else [body]
+        if len(elts) != len(spec.block):
+            return
+        grid_dims = [shapes.dim_of(g) for g in grid]
+        for k, elt in enumerate(elts):
+            if not divides_ok[k]:
+                continue
+            b, d = spec.block[k], shape[k]
+            if b is None or d is None:
+                continue
+            if isinstance(elt, ast.Name) and elt.id in params:
+                covered = dim_mul(grid_dims[params.index(elt.id)], b)
+                if covered is not None and covered != d and \
+                        covered.is_int and d.is_int:
+                    yield Finding(
+                        path=fi.relpath, line=call.lineno,
+                        col=call.col_offset, rule=self.rule,
+                        message=(
+                            f"{where} grid x block covers {covered!r} of "
+                            f"dim {k} but the array dim is {d!r}"
+                        ),
+                    )
+            elif isinstance(elt, ast.Constant) and elt.value == 0:
+                if b != d and b.is_int and d.is_int:
+                    yield Finding(
+                        path=fi.relpath, line=call.lineno,
+                        col=call.col_offset, rule=self.rule,
+                        message=(
+                            f"{where} index_map pins dim {k} to block 0 "
+                            f"but block {b!r} != array dim {d!r} — the "
+                            "rest of the dim is never visited"
+                        ),
+                    )
+
+    # ---------------------------------------------------------------- stores
+
+    def _check_store_dtypes(self, fi: FileInfo, call: ast.Call, kernel: FnVal,
+                            n_in: int,
+                            out_shape: List[object]) -> Iterable[Finding]:
+        fn = kernel.node
+        if isinstance(fn, ast.Lambda):
+            return
+        args = fn.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        params = params[kernel.bound_pos:]
+        out_refs = {
+            name: j for j, name in enumerate(params[n_in:n_in + len(out_shape)])
+        }
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not (
+                isinstance(target, ast.Subscript)
+                and isinstance(target.value, ast.Name)
+                and target.value.id in out_refs
+            ):
+                continue
+            value = node.value
+            if not (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr == "astype"
+                and value.args
+            ):
+                continue
+            dtype_node = value.args[0]
+            dtype = None
+            if isinstance(dtype_node, ast.Attribute) and \
+                    dtype_node.attr in shapes._DTYPE_NAMES:
+                dtype = dtype_node.attr.rstrip("_")
+            elif isinstance(dtype_node, ast.Constant) and \
+                    isinstance(dtype_node.value, str):
+                dtype = dtype_node.value
+            if dtype is None:
+                continue
+            j = out_refs[target.value.id]
+            decl = out_shape[j]
+            if isinstance(decl, ShapeDtypeVal) and decl.dtype is not None and \
+                    decl.dtype != dtype:
+                yield Finding(
+                    path=fi.relpath, line=node.lineno, col=node.col_offset,
+                    rule=self.rule,
+                    message=(
+                        f"kernel stores output {j} as {dtype} but "
+                        f"out_shape declares {decl.dtype}"
+                    ),
+                )

@@ -1,0 +1,160 @@
+"""DK126 — consumer/producer sharding drift: the static twin of the
+resharding XLA inserts silently at runtime.
+
+A value annotated with a NamedSharding (``jax.device_put(x,
+NamedSharding(mesh, P('workers')))`` or ``with_sharding_constraint``)
+that then flows — through reaching definitions — into a ``shard_map``
+(or a ``jit(..., in_shardings=...)``) whose spec for that operand
+partitions a **different axis set** forces an all-to-all/all-gather
+reshard at the boundary.  On device that is a silent performance cliff;
+off device it is invisible.  The runtime side of this story is the
+engine's resharding path; this rule is its static twin (see the
+static↔runtime twin table in API.md).
+
+Flagged only when both ends are provable: the producer's spec resolves,
+partitions at least one axis, and the consumer's spec for the same
+operand resolves to a different axis set.  A replicated producer
+(``P()``) feeding a partitioned consumer is *not* flagged — sharding a
+replicated value is how values enter meshes.  Unresolvable ends are
+trusted (DK104/DK108 stance).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.dklint import shapes
+from tools.dklint.core import Checker, FileInfo, Finding, Project
+from tools.dklint.registry import register
+from tools.dklint.shapes import (
+    UNKNOWN, ArrayVal, Evaluator, ShardingVal, SpecVal,
+)
+
+
+def _axis_set(spec) -> Optional[Set[str]]:
+    if isinstance(spec, SpecVal):
+        return spec.axis_names()
+    return None
+
+
+@register
+class ShardingDriftChecker(Checker):
+    rule = "DK126"
+    name = "producer-consumer-sharding-drift"
+    description = (
+        "NamedSharding-annotated value flows into a shard_map/jit whose "
+        "spec partitions a different axis set — a silent reshard at the "
+        "boundary (static twin of the runtime resharding path)"
+    )
+
+    def collect(self, project: Project, fi: FileInfo) -> None:
+        shapes.collect_facts(project, fi)
+
+    def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
+        for site in shapes.shard_map_sites(project, fi):
+            if site.invoke is None:
+                continue
+            specs = self._leaf_specs(site.in_specs, len(site.invoke.args))
+            if specs is None:
+                continue
+            yield from self._check_invoke(
+                project, fi, site.invoke, specs, "shard_map"
+            )
+        yield from self._check_jit_sites(project, fi)
+
+    # --------------------------------------------------------------- helpers
+
+    def _leaf_specs(self, in_specs,
+                    n_operands: int) -> Optional[List[object]]:
+        if isinstance(in_specs, SpecVal):
+            return [in_specs] * n_operands
+        if isinstance(in_specs, tuple):
+            if len(in_specs) != n_operands:
+                return None  # DK123's length mismatch, not drift
+            return [
+                s if isinstance(s, (SpecVal, ShardingVal)) else UNKNOWN
+                for s in in_specs
+            ]
+        return None
+
+    def _check_invoke(self, project: Project, fi: FileInfo, invoke: ast.Call,
+                      specs: List[object], what: str) -> Iterable[Finding]:
+        if any(isinstance(a, ast.Starred) for a in invoke.args) or \
+                invoke.keywords:
+            return
+        facts = shapes._facts_for(project, fi)
+        ev = Evaluator(project, fi, facts.encl.get(id(invoke)))
+        for i, operand in enumerate(invoke.args):
+            consumer = specs[i]
+            if isinstance(consumer, ShardingVal):
+                consumer = consumer.spec
+            consumer_axes = _axis_set(consumer)
+            if consumer_axes is None:
+                continue
+            got = ev.eval(operand)
+            if not isinstance(got, ArrayVal) or got.sharding is None:
+                continue
+            producer = got.sharding.spec
+            producer_axes = _axis_set(producer)
+            if producer_axes is None or not producer_axes:
+                continue
+            if producer_axes != consumer_axes:
+                yield Finding(
+                    path=fi.relpath, line=invoke.lineno,
+                    col=invoke.col_offset, rule=self.rule,
+                    message=(
+                        f"operand {i} carries NamedSharding {producer!r} "
+                        f"(axes {sorted(producer_axes)}) but the {what} "
+                        f"spec is {consumer!r} (axes "
+                        f"{sorted(consumer_axes)}) — XLA will silently "
+                        "reshard at the boundary"
+                    ),
+                )
+
+    def _check_jit_sites(self, project: Project,
+                         fi: FileInfo) -> Iterable[Finding]:
+        facts = shapes._facts_for(project, fi)
+        jit_specs = {}
+        for call, encl in facts.calls:
+            _resolved, short = shapes.resolved_call(fi, call)
+            if short != "jit":
+                continue
+            in_shardings = None
+            for kw in call.keywords:
+                if kw.arg == "in_shardings":
+                    in_shardings = kw.value
+            if in_shardings is None:
+                continue
+            ev = Evaluator(project, fi, encl)
+            got = ev.eval(in_shardings)
+            if isinstance(got, (SpecVal, ShardingVal)):
+                got = (got,)
+            if isinstance(got, tuple):
+                jit_specs[id(call)] = [
+                    s if isinstance(s, (SpecVal, ShardingVal)) else UNKNOWN
+                    for s in got
+                ]
+        if not jit_specs:
+            return
+        for call, encl in facts.calls:
+            func = call.func
+            target = None
+            if isinstance(func, ast.Call) and id(func) in jit_specs:
+                target = jit_specs[id(func)]
+            elif isinstance(func, ast.Name) and encl is not None:
+                import tools.dklint.dataflow as dataflow
+                flow = dataflow.function_flow(encl, facts.flows)
+                if flow.is_use(func):
+                    defs = flow.reaching(func)
+                    if len(defs) == 1 and defs[0].value is not None and \
+                            id(defs[0].value) in jit_specs:
+                        target = jit_specs[id(defs[0].value)]
+            if target is None:
+                continue
+            specs = target
+            if len(specs) == 1 and len(call.args) > 1:
+                specs = specs * len(call.args)
+            if len(specs) != len(call.args):
+                continue
+            yield from self._check_invoke(project, fi, call, specs, "jit")

@@ -155,6 +155,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "emits a SARIF 2.1.0 log for code-scanning upload")
     p.add_argument("--list-rules", action="store_true",
                    help="list registered rules and exit")
+    p.add_argument("--shapes-report", action="store_true",
+                   help="dump the inferred per-engine layout table (meshes, "
+                        "partition specs, pallas grids) instead of linting — "
+                        "a reviewable artifact so layout changes show up in "
+                        "PR diffs")
     return p
 
 
@@ -168,6 +173,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     root = os.path.abspath(args.root or os.getcwd())
     select = [s for s in (args.select or "").split(",") if s] or None
+
+    if args.shapes_report:
+        from tools.dklint import shapes
+        try:
+            print(shapes.layout_report(args.paths, root), end="")
+        except (FileNotFoundError, ValueError) as e:
+            print(f"dklint: {e}", file=sys.stderr)
+            return 2
+        except SyntaxError as e:
+            print(f"dklint: cannot parse {e.filename}:{e.lineno}: {e.msg}",
+                  file=sys.stderr)
+            return 1
+        return 0
+
     try:
         findings, files = core.analyze(args.paths, root=root, select=select,
                                        jobs=args.jobs)
@@ -203,6 +222,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not args.no_baseline and os.path.exists(baseline_path):
         entries = core.load_baseline(baseline_path)
         findings, stale = core.apply_baseline(findings, entries, files)
+        if select:
+            # a --select run produces no findings for other rules, so
+            # their baseline entries would all look stale — only entries
+            # for selected rules are decidable here
+            stale = [e for e in stale if e.get("rule") in select]
 
     if args.since:
         try:
@@ -234,17 +258,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         for f in findings:
             print(f.render())
-        for e in stale:
-            print(
-                f"dklint: stale baseline entry ({e.get('path')}: {e.get('rule')} "
-                f"{e.get('text', '')!r}) — violation fixed? prune it",
-                file=sys.stderr,
-            )
         if findings:
             print(
                 f"dklint: {len(findings)} unbaselined finding(s)",
                 file=sys.stderr,
             )
+
+    # stale warnings go to stderr in *every* format — CI greps the lint
+    # legs (which run --format github) to assert none slip through
+    for e in stale:
+        print(
+            f"dklint: stale baseline entry ({e.get('path')}: {e.get('rule')} "
+            f"{e.get('text', '')!r}) — violation fixed? prune it",
+            file=sys.stderr,
+        )
 
     return 1 if findings else 0
 
