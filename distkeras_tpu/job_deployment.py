@@ -529,6 +529,8 @@ class PunchcardServer:
                 send_data(conn, {"status": "ok", **self._fleet_snapshot()})
             elif action == "slo_status":
                 send_data(conn, {"status": "ok", **self._fleet_slo()})
+            elif action == "ledger_status":
+                send_data(conn, {"status": "ok", **self._fleet_ledger()})
             else:
                 send_data(conn, {"status": "bad_request"})
         except TimeoutError:
@@ -962,6 +964,30 @@ class PunchcardServer:
         return {"engines": engines, "firing": firing,
                 "firing_count": len(firing), "timeseries": merged}
 
+    def _fleet_ledger(self) -> dict:
+        """Fleet accounting view (``ledger_status`` verb): every live job's
+        ``/ledger`` table plus the daemon's own process, merged tenant-wise
+        (bucket-exact, see :func:`accounting.merge_ledgers`) — what
+        ``dkmon top --daemon host:port`` renders."""
+        from distkeras_tpu.telemetry import accounting
+
+        with self._cv:
+            jobs = list(self.jobs.items())
+        tables = []
+        scraped = 0
+        for jid, job in jobs:
+            body = self._job_live_json(job, "ledger")
+            if body and body.get("enabled"):
+                tables.append(body)
+                scraped += 1
+        own = accounting.ledger_payload()
+        if own.get("enabled"):
+            tables.append(own)
+        merged = accounting.merge_ledgers(tables)
+        merged["enabled"] = bool(tables)
+        merged["jobs"] = scraped
+        return merged
+
 
 class Job:
     """Client: package a training script, submit it, poll for the result
@@ -1229,6 +1255,13 @@ class Job:
         onto one time axis.  ``dkmon status --daemon host:port`` renders
         this; ``dkmon check`` gates on ``firing_count``."""
         return self._rpc({"action": "slo_status"})
+
+    def ledger_status(self) -> dict:
+        """Fleet per-tenant accounting view (``ledger_status`` verb): every
+        live job's ``/ledger`` table plus the daemon's own, merged
+        tenant-wise with shares recomputed over the merged totals.  ``dkmon
+        top --daemon host:port`` renders this."""
+        return self._rpc({"action": "ledger_status"})
 
     def wait(self, timeout: float = 300.0, poll: float = 0.2) -> dict:
         # monotonic, not wall-clock: an NTP step mid-poll must not shrink or

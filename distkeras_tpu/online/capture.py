@@ -12,8 +12,12 @@ directory can never see a torn shard.
 
 Admission is governed by a :class:`SamplingPolicy`: a deterministic sampling
 rate (seeded per-sequence-number, no RNG state to checkpoint), an optional
-content filter, and a per-tenant window quota so one hot client cannot
-dominate a retrain window.
+content filter, a per-tenant window quota so one hot client cannot dominate
+a retrain window, and an optional per-tenant *rate* policy keyed off the
+accounting ledger's rolling usage
+(:mod:`distkeras_tpu.telemetry.accounting`) — tenants above the target
+tokens-or-samples/sec are deterministically thinned back to it through the
+same splitmix admit path.
 
 Crash safety is journal-based: every *offered* sample — admitted or dropped,
 with its decision — appends one line to the current window's journal before
@@ -74,6 +78,11 @@ def online_metrics(registry=None) -> dict:
             "online_quota_drops_total",
             help="served samples dropped by the per-tenant window quota",
         ),
+        "rate_drops": registry.counter(
+            "online_rate_drops_total",
+            help="served samples dropped by the per-tenant rate policy "
+                 "(rolling ledger rate above the configured tenant_rate)",
+        ),
         "capture_errors": registry.counter(
             "online_capture_errors_total",
             help="capture hook failures swallowed at the serving path "
@@ -120,39 +129,73 @@ class SamplingPolicy:
     ``tenant_quota``: max admitted samples any one tenant gets per window —
     the fairness backstop that keeps a hot client from flooding a retrain
     window (dropped-by-quota is separately counted and surfaced).
+    ``tenant_rate``: a per-tenant *rate* target in ``rate_unit``/sec
+    (``"samples"`` or ``"tokens"``), judged against the accounting
+    ``ledger``'s rolling usage
+    (:meth:`~distkeras_tpu.telemetry.accounting.TenantLedger.rolling_rate`):
+    a tenant running above the target is thinned with admission probability
+    ``target / observed`` through a decorrelated splitmix draw — the same
+    stateless (seed, seq) determinism as ``rate``, so resume re-derives the
+    decisions given the same observed rates.  Without a ``ledger`` (or for
+    a tenant it has never seen) the rate policy admits — no usage signal,
+    no throttle.
     """
 
     def __init__(self, rate: float = 1.0,
                  tenant_quota: Optional[int] = None,
                  filter: Optional[Callable] = None,  # noqa: A002 — API word
-                 seed: int = 0):
+                 seed: int = 0,
+                 tenant_rate: Optional[float] = None,
+                 rate_unit: str = "samples",
+                 ledger=None):
         if not 0.0 <= rate <= 1.0:
             raise ValueError(f"rate must be in [0, 1], got {rate}")
         if tenant_quota is not None and tenant_quota < 1:
             raise ValueError(f"tenant_quota must be >= 1, got {tenant_quota}")
+        if tenant_rate is not None and tenant_rate <= 0:
+            raise ValueError(f"tenant_rate must be > 0, got {tenant_rate}")
+        if rate_unit not in ("samples", "tokens"):
+            raise ValueError(
+                f"rate_unit must be 'samples' or 'tokens', got {rate_unit!r}")
         self.rate = float(rate)
         self.tenant_quota = None if tenant_quota is None else int(tenant_quota)
         self.filter = filter
         self.seed = int(seed)
+        self.tenant_rate = None if tenant_rate is None else float(tenant_rate)
+        self.rate_unit = rate_unit
+        self.ledger = ledger
 
-    def _keep(self, seq: int) -> bool:
+    def _uniform(self, seq: int) -> float:
         # splitmix64 finalizer over (seed, seq): uniform enough for a
         # sampling gate, stateless, and bit-stable across platforms
         x = ((self.seed << 32) ^ seq) & 0xFFFFFFFFFFFFFFFF
         x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & 0xFFFFFFFFFFFFFFFF
         x = (x ^ (x >> 27)) * 0x94D049BB133111EB & 0xFFFFFFFFFFFFFFFF
         x ^= x >> 31
-        return (x >> 11) / float(1 << 53) < self.rate
+        return (x >> 11) / float(1 << 53)
+
+    def _keep(self, seq: int) -> bool:
+        return self._uniform(seq) < self.rate
 
     def admit(self, seq: int, tenant: str, tenant_count: int,
               prompt, tokens) -> Optional[str]:
         """``None`` to admit, else the drop reason (``"sampled"``,
-        ``"filtered"``, ``"quota"``).  ``tenant_count`` is the tenant's
-        admitted-sample count in the current window."""
+        ``"filtered"``, ``"rate"``, ``"quota"``).  ``tenant_count`` is the
+        tenant's admitted-sample count in the current window."""
         if self.rate < 1.0 and not self._keep(seq):
             return "sampled"
         if self.filter is not None and not self.filter(prompt, tokens):
             return "filtered"
+        if self.tenant_rate is not None and self.ledger is not None:
+            unit = "tokens" if self.rate_unit == "tokens" else "requests"
+            observed = self.ledger.rolling_rate(tenant, unit=unit)
+            if observed > self.tenant_rate:
+                # thin to the target: admit with p = target/observed; the
+                # xor decorrelates this draw from the sampling-rate draw so
+                # the two gates stay independent per sequence number
+                draw = self._uniform(seq ^ 0x9E3779B97F4A7C15)
+                if draw >= self.tenant_rate / observed:
+                    return "rate"
         if self.tenant_quota is not None and tenant_count >= self.tenant_quota:
             return "quota"
         return None
@@ -384,6 +427,8 @@ class TrafficLog:
                     self._metrics["dropped"].inc()
                     if reason == "quota":
                         self._metrics["quota_drops"].inc()
+                    elif reason == "rate":
+                        self._metrics["rate_drops"].inc()
                 return False
             row = np.full(self.max_len, self.pad_id, dtype=np.int32)
             merged = (prompt + tokens)[:self.max_len]

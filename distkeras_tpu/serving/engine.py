@@ -78,6 +78,7 @@ import numpy as np
 
 from distkeras_tpu import chaos as _chaos
 from distkeras_tpu.sanitizer import lockwatch
+from distkeras_tpu.telemetry import accounting as _accounting
 from distkeras_tpu.telemetry import runtime as _truntime
 from distkeras_tpu.telemetry.trace import NOOP_SPAN, trace as _trace
 from distkeras_tpu.serving.cache import PagedKVCache, append_rows, rollback_rows
@@ -327,13 +328,15 @@ class _Pending:
 class _SlotState:
     """Host-side record for one occupied batch slot."""
 
-    __slots__ = ("pending", "tokens", "plen", "ttft_s")
+    __slots__ = ("pending", "tokens", "plen", "ttft_s", "pages", "admit_t")
 
     def __init__(self, pending: _Pending, plen: int):
         self.pending = pending
         self.tokens: List[int] = []
         self.plen = plen
         self.ttft_s = 0.0
+        self.pages = 0        # pages held — the page-seconds numerator
+        self.admit_t = 0.0    # prefill-done wall time — its clock start
 
 
 # -------------------------------------------------------------------- engine
@@ -383,6 +386,11 @@ class ServingEngine:
             prefill_buckets, self._cache.page_size, self._width)
         self._queue = RequestQueue(queue_size)
         self._metrics = serving_metrics(registry)
+        # per-tenant ledger (None when DISTKERAS_ACCOUNTING is off): every
+        # billing site meters from already-host-visible bookkeeping, so the
+        # flag-off path keeps a single `is None` check and the traced
+        # programs are byte-identical either way
+        self._ledger = _accounting.maybe_ledger(registry)
 
         # ------------------------------------------------ tensor parallelism
         self._mesh = mesh
@@ -1125,10 +1133,12 @@ class ServingEngine:
                 "serving.queue_wait", pending.enqueue_t, t0,
                 request_id=req.request_id, trace_id=req.trace_id,
                 parent="serving.admit")
-            span = _trace.span(
-                "serving.prefill", request_id=req.request_id,
-                trace_id=req.trace_id, parent="serving.admit", slot=slot,
-                width=width, plen=plen)
+            attrs: Dict[str, Any] = dict(
+                request_id=req.request_id, trace_id=req.trace_id,
+                parent="serving.admit", slot=slot, width=width, plen=plen)
+            if req.tenant:
+                attrs["tenant"] = req.tenant
+            span = _trace.span("serving.prefill", **attrs)
         with span:
             tokens = np.zeros((1, width), np.int32)
             tokens[0, :plen] = req.prompt
@@ -1161,8 +1171,17 @@ class ServingEngine:
         state = _SlotState(pending, plen)
         state.tokens.append(tok0)
         state.ttft_s = now - pending.enqueue_t
+        state.pages = need
+        state.admit_t = now
         self._metrics["ttft"].observe(state.ttft_s)
         self._metrics["tokens"].inc()
+        if self._ledger is not None:
+            # prompt tokens, queue wait, prefill device-seconds, and the
+            # first sampled token bill at admission — all host-visible
+            self._ledger.admit(
+                req.tenant, prompt_tokens=plen,
+                queue_wait_s=t0 - pending.enqueue_t,
+                device_s=now - t0, generated=1)
         self._slots[slot] = state
         self._pos[slot] = plen
         self._last[slot] = tok0
@@ -1214,6 +1233,11 @@ class ServingEngine:
             attrs["trace_id"] = traces[0]
         elif traces:
             attrs["trace_ids"] = traces
+        tenants = sorted({r.tenant for r in reqs if r.tenant})
+        if len(tenants) == 1:
+            attrs["tenant"] = tenants[0]
+        elif tenants:
+            attrs["tenants"] = tenants
         return _trace.span("serving.decode_step", **attrs)
 
     def _plain_once(self) -> None:
@@ -1229,8 +1253,13 @@ class ServingEngine:
             self._cache.k_pages, self._cache.v_pages = kp, vp
             toks = np.asarray(tok)      # device sync: the step is done here
         self._keys = np.array(keys)     # np.array: keep the host copy writable
-        self._metrics["token_latency"].observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._metrics["token_latency"].observe(dt)
         self._metrics["decode_steps"].inc()
+        ledger = self._ledger
+        # device-seconds estimate: the step's wall time split evenly over
+        # the slots it decoded for (captured before retirements mutate it)
+        share = dt / max(1, int(self._active.sum()))
 
         for slot in range(self.num_slots):
             state = self._slots[slot]
@@ -1239,6 +1268,9 @@ class ServingEngine:
             t = int(toks[slot])
             state.tokens.append(t)
             self._metrics["tokens"].inc()
+            if ledger is not None:
+                ledger.decode(state.pending.request.tenant,
+                              tokens=1, device_s=share)
             self._pos[slot] += 1
             self._last[slot] = t
             eos = state.pending.request.eos_id
@@ -1284,19 +1316,28 @@ class ServingEngine:
             acc = np.asarray(accepted)
         self._keys = np.array(keys)
         self._draft_keys = np.array(dkeys)
-        self._metrics["token_latency"].observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._metrics["token_latency"].observe(dt)
         self._metrics["decode_steps"].inc()
         spec_slots = self._active & self._spec_on
         n_spec = int(spec_slots.sum())
         if n_spec:
             self._metrics["spec_proposed"].inc(m * n_spec)
             self._metrics["spec_accepted"].inc(int(acc[spec_slots].sum()))
+        ledger = self._ledger
+        share = dt / max(1, int(self._active.sum()))
 
         for slot in range(self.num_slots):
             state = self._slots[slot]
             if state is None or not self._active[slot]:
                 continue
             req = state.pending.request
+            if ledger is not None and spec_slots[slot]:
+                # accepted + rejected = m per spec slot, so the tenant sums
+                # conserve against serving_spec_{proposed,accepted}_total
+                accepted = int(acc[slot])
+                ledger.speculative(req.tenant, accepted=accepted,
+                                   rejected=m - accepted)
             retired = False
             emitted = 0
             for j in range(int(counts[slot])):
@@ -1312,12 +1353,19 @@ class ServingEngine:
                     self._retire(slot, "length")
                     retired = True
                     break
+            if ledger is not None:
+                ledger.decode(req.tenant, tokens=emitted, device_s=share)
             if not retired:
                 self._pos[slot] += emitted
                 self._last[slot] = int(out[slot, emitted - 1])
 
     def _retire(self, slot: int, reason: str) -> None:
         state = self._slots[slot]
+        if self._ledger is not None:
+            # page-seconds sample at slot free: pages held x wall time
+            self._ledger.release(
+                state.pending.request.tenant, pages=state.pages,
+                held_s=time.perf_counter() - state.admit_t)
         self._cache.free(slot)
         self._slots[slot] = None
         self._active[slot] = False

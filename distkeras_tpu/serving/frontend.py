@@ -56,9 +56,16 @@ class GenerateRequest:
     HTTP hop in the body and as ``X-DK-Trace-Id``); ``request_id`` stays
     the idempotency key.  Both ride trace-span args, never metric labels
     (dklint DK117).  ``tenant`` names the client on whose behalf the
-    request runs — the accounting key the online capture layer's per-tenant
-    window quotas meter on (:mod:`distkeras_tpu.online`); empty means
-    untagged (all untagged traffic shares one quota bucket).
+    request runs — the accounting key: the per-tenant usage ledger
+    (:mod:`distkeras_tpu.telemetry.accounting`) bills tokens, queue wait,
+    KV page-seconds, and device-seconds to it, and the online capture
+    layer's per-tenant quotas/rates meter on it
+    (:mod:`distkeras_tpu.online`).  Resolved once at the outermost hop
+    that sees the request (router or frontend, from the body or the
+    ``x-dk-tenant`` header) and inherited unchanged by every inner hop;
+    empty means untagged (all untagged traffic shares one
+    ``__untagged__`` bucket).  Like the ids it rides trace-span args and
+    the ledger's bounded table, never raw metric labels (DK117).
     """
 
     prompt: List[int]
@@ -275,6 +282,8 @@ def install_http_endpoint(engine, path: str = "/generate",
         if not req.trace_id:
             req.trace_id = new_trace_id()
         span_attrs = {"request_id": req.request_id, "trace_id": req.trace_id}
+        if req.tenant:
+            span_attrs["tenant"] = req.tenant
         parent = (request.get("headers") or {}).get("x-dk-parent-span")
         if parent:
             span_attrs["parent"] = str(parent)

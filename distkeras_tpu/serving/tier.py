@@ -77,6 +77,7 @@ from distkeras_tpu.serving.frontend import (
     GenerateResult,
     QueueFull,
 )
+from distkeras_tpu.telemetry import accounting as _accounting
 from distkeras_tpu.telemetry import runtime as _truntime
 from distkeras_tpu.telemetry.trace import (
     NOOP_SPAN,
@@ -421,6 +422,7 @@ class ServingTier:
                  backoff_cap_s: float = 0.25,
                  registry=None,
                  slo_objectives: Optional[Sequence] = None,
+                 traffic_log=None,
                  clock: Callable[[], float] = time.monotonic):
         if not replicas:
             raise ValueError("a serving tier needs at least one replica")
@@ -440,6 +442,16 @@ class ServingTier:
         self._clock = clock
         self._metrics = tier_metrics(registry)
         self._registry = registry
+        # per-tenant accounting (None when DISTKERAS_ACCOUNTING is off):
+        # the router bills each request exactly once at completion —
+        # failed failover attempts fold into that one bill, never counted
+        # per attempt
+        self._acct = _accounting.maybe_ledger(registry)
+        # router-level online capture (satellite of the accounting plane):
+        # the tenant is resolved once here and inherited by capture and
+        # accounting alike, so a replica frontend no longer has to carry
+        # its own hook to close the serve->train loop
+        self._traffic_log = traffic_log
         # replica liveness rides the fleet lease machinery: a successful
         # probe is a heartbeat; a replica that misses probe_misses probes'
         # worth of lease is swept exactly like a preempted trainer
@@ -637,9 +649,12 @@ class ServingTier:
             request = dataclasses.replace(request, trace_id=new_trace_id())
         root = NOOP_SPAN
         if _truntime.enabled():
-            root = _trace.span(
-                "tier.request", request_id=request.request_id,
-                trace_id=request.trace_id, budget_s=round(float(budget), 3))
+            attrs = dict(request_id=request.request_id,
+                         trace_id=request.trace_id,
+                         budget_s=round(float(budget), 3))
+            if request.tenant:
+                attrs["tenant"] = request.tenant
+            root = _trace.span("tier.request", **attrs)
         with _trace.bind(trace_id=request.trace_id,
                          request_id=request.request_id), root:
             try:
@@ -754,10 +769,41 @@ class ServingTier:
                     self._backoff(attempts, deadline)
                     continue
                 _span_note(aspan, outcome="ok")
-                self._metrics["latency"].observe(time.perf_counter() - t0)
+                latency = time.perf_counter() - t0
+                self._metrics["latency"].observe(latency)
                 self._metrics["attempts"].observe(attempts)
                 self._metrics["requests"].inc()
+                if self._acct is not None:
+                    self._acct.request(request.tenant, attempts=attempts,
+                                       latency_s=latency)
+                self._offer_capture(request, result)
                 return result
+
+    # ------------------------------------------------------ online capture
+
+    def attach_traffic_log(self, traffic_log) -> None:
+        """Attach (or replace) the router-level capture hook after
+        construction — what :func:`install_tier_endpoint` uses when handed
+        a ``traffic_log``."""
+        self._traffic_log = traffic_log
+
+    def _offer_capture(self, request: GenerateRequest, result) -> None:
+        """Offer a completed generation to the capture ring.  Strictly
+        best-effort: a capture fault is counted and swallowed, never
+        surfaced to the caller — routing must not fail because capture
+        did (same contract as the frontend hook)."""
+        log = self._traffic_log
+        if log is None:
+            return
+        try:
+            log.record(request, result)
+        except Exception:  # noqa: BLE001 — capture is best-effort
+            from distkeras_tpu import telemetry
+
+            if telemetry.enabled():
+                from distkeras_tpu.online.capture import online_metrics
+
+                online_metrics()["capture_errors"].inc()
 
     # ----------------------------------------------------- rolling hot-swap
 
@@ -926,14 +972,20 @@ def watch_and_swap(engine, directory: str, loader,
 
 
 def install_tier_endpoint(tier: ServingTier, path: str = "/generate",
-                          status_path: str = "/tier") -> str:
+                          status_path: str = "/tier",
+                          traffic_log=None) -> str:
     """Mount the router on the flightdeck exporter: ``path`` routes
     requests across the tier (maps :class:`TierSaturated` → 503 +
     ``Retry-After``, :class:`TierDeadline` → 504, :class:`TierExhausted`
-    → 502), ``status_path`` serves the health snapshot.  Returns the
-    mounted path."""
+    → 502), ``status_path`` serves the health snapshot.  ``traffic_log``
+    attaches router-level online capture — the preferred hook point, so
+    tenant resolution, accounting, and capture all happen once at the
+    router instead of per replica frontend.  Returns the mounted path."""
     from distkeras_tpu.serving.frontend import _parse_request
     from distkeras_tpu.telemetry.flightdeck import server as _server
+
+    if traffic_log is not None:
+        tier.attach_traffic_log(traffic_log)
 
     def handle(request):
         try:

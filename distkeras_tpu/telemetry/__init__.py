@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import os
 
-from distkeras_tpu.telemetry import dynamics, flightdeck, runtime
+from distkeras_tpu.telemetry import accounting, dynamics, flightdeck, runtime
 from distkeras_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -44,6 +44,7 @@ __all__ = [
     "Registry",
     "Span",
     "Tracer",
+    "accounting",
     "configure",
     "dynamics",
     "enabled",

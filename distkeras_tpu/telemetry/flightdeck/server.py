@@ -28,6 +28,10 @@ Endpoints:
     The rollup ring (``DISTKERAS_ROLLUP``): fixed-interval history of every
     instrument, the raw feed for SLO burn rates and ``dkmon watch``.
     ``?since=<unix>`` / ``?name=<metric>`` (repeatable) filter the samples.
+``/ledger``
+    The per-tenant accounting ledger (``DISTKERAS_ACCOUNTING``): the
+    bounded top-K usage table as JSON — what ``dkmon top`` renders and the
+    daemon's ``ledger_status`` verb fleet-merges.
 
 Handlers only *read* registry snapshots and the recorder ring (each guarded
 by its own cheap lock), so scraping never blocks the training loop.  The
@@ -286,6 +290,10 @@ def _render(path: str, request: Optional[dict] = None):
         from distkeras_tpu.telemetry.flightdeck import rollup as _rollup
 
         return _rollup.timeseries_view(request)
+    if path == "/ledger":
+        from distkeras_tpu.telemetry import accounting as _accounting
+
+        return _accounting.ledger_view(request)
     if path == "/trace":
         payload = rec.trace_export(origin=_tracer._origin)
         query = parse_qs((request or {}).get("query") or "")
@@ -337,7 +345,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if payload is None:
             known = ["/metrics", "/healthz", "/vars", "/trace",
-                     "/timeseries", *sorted(_EXTRA)]
+                     "/timeseries", "/ledger", *sorted(_EXTRA)]
             self._reply(404, "text/plain", "not found; endpoints: " + " ".join(known))
             return
         ctype, text, status = payload[:3]

@@ -1,0 +1,45 @@
+"""DK117 fixture — raw tenant strings leaking into metric names/labels.
+
+Tenant identifiers are one-per-client and externally controlled: a metric
+labeled by tenant grows one series per caller-chosen string.  Attribution
+belongs in the bounded top-K accounting ledger
+(``distkeras_tpu.telemetry.accounting``), which is the one module exempt
+from this rule — the exemption test copies this file to that module path.
+
+Package-scoped rule: the test copies this file into a synthetic
+``distkeras_tpu`` package under tmp_path.  Keep edits append-only or
+update the test.
+"""
+
+
+def leaky(registry, req, tenant):
+    # 1. f-string metric name interpolating tenant
+    registry.counter(f"requests_{req.tenant}_total", help="per-tenant!")
+    # 2. % composition with a tenant_id variable
+    tenant_id = req.tenant_id
+    registry.gauge("inflight_%s" % tenant_id, help="per-tenant!")
+    # 3. labels= dict with a tenant KEY
+    registry.to_prometheus(labels={"tenant": tenant})
+    # 4. labels= dict whose VALUE reads tenant_id
+    registry.to_prometheus(labels={"client": req.tenant_id})
+    # 5. labels= as a non-dict expression reading a tenant
+    registry.to_prometheus(labels=make_labels(req.tenant))
+    return registry
+
+
+def make_labels(tenant):
+    return {"client": tenant}
+
+
+def clean(registry, trace, req, ledger):
+    # literal metric names are fine — no value can leak into them
+    c = registry.counter("requests_total", help="bounded")
+    c.inc()
+    # bounded deploy-scoped labels are fine
+    registry.to_prometheus(labels={"run_id": "fleet1234", "zone": "a"})
+    # span args are the sanctioned per-request home for the tenant
+    with trace.span("tier.request", tenant=req.tenant):
+        pass
+    # the ledger API is the sanctioned aggregation home
+    ledger.admit(req.tenant, prompt_tokens=3, queue_wait_s=0.0, device_s=0.0)
+    return c

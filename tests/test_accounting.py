@@ -1,0 +1,523 @@
+"""Per-tenant accounting tests: tenant-summed ledger tokens must equal the
+``serving_tokens_total`` family *exactly* under concurrent mixed-tenant load
+(conservation), failed failover attempts bill exactly once per request (the
+chaos kill test), top-K eviction keeps the tenant table bounded while
+conserving totals into ``__other__``, ``DISTKERAS_ACCOUNTING=0`` leaves the
+engine's traced programs byte-identical (flag-off lowering pin), and the
+aggregate ``accounting_*`` schema is pinned as golden Prometheus text."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import chaos, telemetry
+from distkeras_tpu.models import TransformerLM
+from distkeras_tpu.models.generate import greedy_generate_module
+from distkeras_tpu.serving import GenerateRequest, ServingEngine, ServingTier
+from distkeras_tpu.telemetry import accounting
+from distkeras_tpu.telemetry.accounting import (
+    OTHER_TENANT,
+    UNTAGGED_TENANT,
+    TenantLedger,
+    merge_ledgers,
+)
+from distkeras_tpu.telemetry.flightdeck import correlate
+from distkeras_tpu.telemetry.flightdeck import server as server_mod
+from distkeras_tpu.telemetry.metrics import Registry
+
+VOCAB = 23
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+@pytest.fixture(autouse=True)
+def clean_accounting(tmp_path, monkeypatch):
+    monkeypatch.setenv("DISTKERAS_TELEMETRY_DIR", str(tmp_path))
+    telemetry.configure(True)
+    accounting.configure(True)
+    telemetry.metrics.reset()
+    accounting.reset()
+    correlate.set_run_id("accttest")
+    chaos.configure("")
+    yield
+    chaos.configure(None)
+    server_mod.stop()
+    server_mod.configure(None)
+    telemetry.metrics.reset()
+    accounting.reset()
+    correlate.set_run_id(None)
+    accounting.configure(None)
+    telemetry.configure(None)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    module = TransformerLM(vocab_size=VOCAB, dim=16, heads=2, num_layers=2,
+                           max_len=32)
+    params = module.init(jax.random.PRNGKey(0),
+                         np.zeros((1, 4), np.int32))["params"]
+    return module, params
+
+
+@pytest.fixture
+def make_engine():
+    engines = []
+
+    def factory(model, params, **kw):
+        kw.setdefault("num_slots", 3)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("registry", Registry())
+        engine = ServingEngine(model, params, **kw)
+        engines.append(engine)
+        return engine
+
+    yield factory
+    for engine in engines:
+        engine.stop()
+
+
+@pytest.fixture
+def make_tier():
+    tiers = []
+
+    def factory(replicas, **kw):
+        kw.setdefault("registry", Registry())
+        tier = ServingTier(replicas, **kw)
+        tiers.append(tier)
+        return tier
+
+    yield factory
+    for tier in tiers:
+        tier.stop(close_replicas=True)
+
+
+def _ref(module, params, prompt, steps):
+    out = greedy_generate_module(
+        module, params, np.asarray([prompt], np.int32), steps)
+    return out[0, len(prompt):].tolist()
+
+
+def _ctr(registry, name):
+    entry = registry.snapshot().get(name)
+    return 0.0 if entry is None else float(entry.get("value") or 0.0)
+
+
+def _rows(payload):
+    return {r["tenant"]: r for r in payload["tenants"]}
+
+
+# ------------------------------------------------------------ metric schema
+
+
+def _golden_bill(registry):
+    """Deterministic billing sequence shared by the golden test and its
+    regeneration script (fixed clock: nothing decays, nothing races)."""
+    ledger = TenantLedger(registry, capacity=4, clock=lambda: 100.0)
+    ledger.admit("acme", prompt_tokens=5, queue_wait_s=0.003, device_s=0.25)
+    ledger.decode("acme", tokens=3, device_s=0.05)
+    ledger.speculative("acme", accepted=2, rejected=1)
+    ledger.release("acme", pages=4, held_s=0.5)
+    ledger.request("acme", attempts=2, latency_s=0.3)
+    ledger.admit("zen", prompt_tokens=2, queue_wait_s=0.2, device_s=0.1)
+    ledger.decode("zen", tokens=1, device_s=0.02)
+    ledger.release("zen", pages=2, held_s=0.25)
+    ledger.request("zen")
+    return ledger
+
+
+def test_accounting_metrics_schema_golden():
+    registry = Registry()
+    _golden_bill(registry)
+    golden = open(os.path.join(GOLDEN, "accounting_metrics.txt")).read()
+    assert registry.to_prometheus(labels={"run_id": "fleet1234"}) == golden
+
+
+def test_golden_bill_snapshot_shape():
+    registry = Registry()
+    ledger = _golden_bill(registry)
+    payload = ledger.snapshot()
+    rows = _rows(payload)
+    assert set(rows) == {"acme", "zen"}
+    acme = rows["acme"]
+    assert acme["prefill_tokens"] == 5 and acme["decode_tokens"] == 4
+    assert acme["spec_accepted"] == 2 and acme["spec_rejected"] == 1
+    assert acme["failover_attempts"] == 1 and acme["requests"] == 1
+    assert acme["page_seconds"] == pytest.approx(2.0)
+    assert acme["device_seconds"]["prefill"] == pytest.approx(0.25)
+    # share is over prefill+decode tokens: acme 9 of 13 (zen: 2+1+1)
+    assert acme["share"] == pytest.approx(9 / 13)
+    assert payload["totals"]["tokens"] == 13
+    assert payload["totals"]["requests"] == 2
+    # rows sort by total tokens descending
+    assert [r["tenant"] for r in payload["tenants"]] == ["acme", "zen"]
+    # registry aggregates can never drift from the table
+    assert _ctr(registry, "accounting_decode_tokens_total") == 6
+    assert _ctr(registry, "accounting_prefill_tokens_total") == 7
+    assert _ctr(registry, "accounting_failover_attempts_total") == 1
+
+
+# ------------------------------------------------- ledger unit behaviour
+
+
+def test_topk_eviction_keeps_cardinality_fixed_and_conserves():
+    t = [0.0]
+    registry = Registry()
+    ledger = TenantLedger(registry, capacity=2, clock=lambda: t[0])
+    ledger.admit("a", prompt_tokens=8, queue_wait_s=0.0, device_s=0.0)
+    ledger.admit("b", prompt_tokens=2, queue_wait_s=0.0, device_s=0.0)
+    # capacity reached: "c" arriving folds the coldest row ("b") into
+    # __other__ — the newcomer always becomes visible
+    ledger.admit("c", prompt_tokens=4, queue_wait_s=0.0, device_s=0.0)
+    rows = _rows(ledger.snapshot())
+    assert set(rows) == {"a", "c", OTHER_TENANT}
+    assert rows[OTHER_TENANT]["prefill_tokens"] == 2
+    assert rows[OTHER_TENANT]["decode_tokens"] == 1
+    # conservation across eviction: nothing lost, nothing double-counted
+    payload = ledger.snapshot()
+    assert payload["totals"]["tokens"] == 8 + 2 + 4 + 3  # prompts + 3 admits
+    assert payload["evictions"] == 1
+    assert _ctr(registry, "accounting_tenant_evictions_total") == 1
+    # a storm of one-shot tenants can never grow the table past K+1
+    for i in range(20):
+        ledger.admit(f"burst{i}", prompt_tokens=1, queue_wait_s=0.0,
+                     device_s=0.0)
+    assert len(ledger.snapshot()["tenants"]) <= ledger.capacity + 1
+    assert _ctr(registry, "accounting_tenants_tracked") <= ledger.capacity
+
+
+def test_rolling_rate_decays_and_ranks_eviction():
+    t = [0.0]
+    ledger = TenantLedger(Registry(), capacity=8, tau_s=30.0,
+                          clock=lambda: t[0])
+    ledger.admit("hot", prompt_tokens=29, queue_wait_s=0.0, device_s=0.0)
+    assert ledger.rolling_rate("hot") == pytest.approx(1.0)  # 30 mass / 30s
+    t[0] += 30.0  # one tau later the rate has decayed by e^-1
+    assert ledger.rolling_rate("hot") == pytest.approx(np.exp(-1.0))
+    assert ledger.rolling_rate("nobody") == 0.0
+    assert ledger.rolling_rate("hot", unit="requests") == 0.0
+    with pytest.raises(ValueError):
+        ledger.rolling_rate("hot", unit="bogus")
+
+
+def test_untagged_requests_share_one_bucket():
+    ledger = TenantLedger(Registry(), clock=lambda: 0.0)
+    ledger.admit("", prompt_tokens=3, queue_wait_s=0.0, device_s=0.0)
+    ledger.admit(None, prompt_tokens=2, queue_wait_s=0.0, device_s=0.0)
+    rows = _rows(ledger.snapshot())
+    assert set(rows) == {UNTAGGED_TENANT}
+    assert rows[UNTAGGED_TENANT]["prefill_tokens"] == 5
+
+
+def test_merge_ledgers_is_bucket_exact():
+    registry = Registry()
+    ledger = _golden_bill(registry)
+    snap = ledger.snapshot()
+    merged = merge_ledgers([snap, snap])
+    rows = _rows(merged)
+    assert rows["acme"]["prefill_tokens"] == 10
+    assert rows["acme"]["decode_tokens"] == 8
+    assert merged["totals"]["tokens"] == 2 * snap["totals"]["tokens"]
+    # share recomputes over the merged fleet, still summing to 1
+    assert sum(r["share"] for r in merged["tenants"]) == pytest.approx(1.0)
+    # bucket counts added per bound: the merged p99 equals the single-ledger
+    # p99 (same distribution, doubled mass)
+    assert rows["acme"]["queue_p99_s"] == pytest.approx(
+        _rows(snap)["acme"]["queue_p99_s"])
+    assert merge_ledgers([]) == merge_ledgers([{}])
+
+
+# ------------------------------------------------ conservation (engine)
+
+
+def test_conservation_under_concurrent_mixed_tenants(lm, make_engine):
+    """The invariant dkcost stands on: tenant-summed ledger tokens equal
+    ``serving_tokens_total`` exactly — no sampling, no drift — even with
+    three tenants interleaving across a shared continuous batch."""
+    module, params = lm
+    registry = Registry()
+    engine = make_engine(module, params, registry=registry)
+    rng = np.random.default_rng(7)
+    jobs = [("acme", rng.integers(0, VOCAB, size=n).tolist(), steps)
+            for n, steps in ((3, 6), (5, 4), (4, 5))]
+    jobs += [("zen", rng.integers(0, VOCAB, size=n).tolist(), steps)
+             for n, steps in ((6, 3), (3, 6))]
+    jobs += [("", rng.integers(0, VOCAB, size=4).tolist(), 4)]
+
+    results = [None] * len(jobs)
+
+    def run(i):
+        tenant, prompt, steps = jobs[i]
+        results[i] = engine.generate(prompt, steps, tenant=tenant,
+                                     timeout=120.0)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(jobs))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    assert all(r is not None for r in results)
+    # bit-equal to the greedy reference: accounting added zero device work
+    for (tenant, prompt, steps), result in zip(jobs, results):
+        assert result.tokens == _ref(module, params, prompt, steps)
+
+    snap = registry.snapshot()
+    payload = engine._ledger.snapshot()
+    rows = _rows(payload)
+    assert set(rows) == {"acme", "zen", UNTAGGED_TENANT}
+    decode_sum = sum(r["decode_tokens"] for r in payload["tenants"])
+    prefill_sum = sum(r["prefill_tokens"] for r in payload["tenants"])
+    assert decode_sum == snap["serving_tokens_total"]["value"]
+    assert prefill_sum == sum(len(p) for _, p, _ in jobs)
+    # the aggregate instruments agree with the table they were fed from
+    assert snap["accounting_decode_tokens_total"]["value"] == decode_sum
+    assert snap["accounting_prefill_tokens_total"]["value"] == prefill_sum
+    assert snap["accounting_queue_wait_seconds"]["count"] == len(jobs)
+    # every retired slot sampled page-seconds and device time is attributed
+    assert all(r["page_seconds"] > 0.0 for r in payload["tenants"])
+    assert rows["acme"]["device_seconds"]["prefill"] > 0.0
+    assert rows["acme"]["device_seconds"]["decode"] > 0.0
+
+
+def test_spec_conservation(lm, make_engine):
+    """Speculative accept/reject splits conserve against the engine's
+    ``serving_spec_{proposed,accepted}_total`` counters."""
+    module, params = lm
+    registry = Registry()
+    # draft IS the target: every proposal accepted, maximum spec traffic
+    engine = make_engine(module, params, draft_model=module,
+                         draft_params=params, spec_tokens=3,
+                         registry=registry)
+    rng = np.random.default_rng(11)
+    prompts = {"acme": rng.integers(0, VOCAB, size=4).tolist(),
+               "zen": rng.integers(0, VOCAB, size=5).tolist()}
+    for tenant, prompt in prompts.items():
+        result = engine.generate(prompt, 6, tenant=tenant, timeout=120.0)
+        assert result.tokens == _ref(module, params, prompt, 6)
+
+    snap = registry.snapshot()
+    payload = engine._ledger.snapshot()
+    accepted = sum(r["spec_accepted"] for r in payload["tenants"])
+    rejected = sum(r["spec_rejected"] for r in payload["tenants"])
+    assert accepted == snap["serving_spec_accepted_total"]["value"]
+    assert accepted + rejected == snap["serving_spec_proposed_total"]["value"]
+    decode_sum = sum(r["decode_tokens"] for r in payload["tenants"])
+    assert decode_sum == snap["serving_tokens_total"]["value"]
+
+
+# ------------------------------------------- failover billed exactly once
+
+
+def test_failover_billed_once_under_chaos(lm, make_tier):
+    """A chaos-killed replica forces failovers; the ledger bills each
+    request exactly once, with failed attempts as ``attempts - 1`` — the
+    tenant-summed row totals must match the router's own histogram."""
+    module, params = lm
+    registry = Registry()
+    engines = [ServingEngine(module, params, num_slots=2, page_size=8,
+                             registry=Registry()) for _ in range(3)]
+    tier = make_tier(engines, probe_interval=0.05,
+                     default_deadline_s=120.0, registry=registry)
+    tier.start()
+
+    rng = np.random.default_rng(3)
+    jobs = [("acme", rng.integers(0, VOCAB, size=n).tolist())
+            for n in (3, 5, 4)]
+    jobs += [("zen", rng.integers(0, VOCAB, size=n).tolist())
+             for n in (6, 3, 5)]
+    chaos.configure("11:kill_replica=2")
+    results = [None] * len(jobs)
+
+    def run(i):
+        tenant, prompt = jobs[i]
+        results[i] = tier.dispatch(
+            GenerateRequest(prompt=prompt, max_new_tokens=6, tenant=tenant),
+            deadline_s=120.0)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(jobs))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+
+    for (tenant, prompt), result in zip(jobs, results):
+        assert result is not None and result.finish_reason != "aborted"
+        assert result.tokens == _ref(module, params, prompt, 6)
+
+    snap = registry.snapshot()
+    routed = snap["serving_tier_routed_total"]["value"]
+    attempts = snap["serving_tier_request_attempts"]
+    payload = tier._acct.snapshot()
+    # one bill per completed request — retries never create a second bill
+    assert sum(r["requests"] for r in payload["tenants"]) == routed == len(jobs)
+    # failed attempts bill as (attempts - 1), summed == the histogram's own
+    # excess over one-attempt-per-request — exact, even under chaos
+    extra = attempts["sum"] - attempts["count"]
+    assert sum(r["failover_attempts"]
+               for r in payload["tenants"]) == extra >= 1
+    assert snap["accounting_requests_total"]["value"] == routed
+    assert snap["accounting_failover_attempts_total"]["value"] == extra
+    fired = telemetry.metrics.snapshot().get("chaos_kill_replica_total")
+    assert fired and fired["value"] == 1
+
+
+# ------------------------------------------------- flag-off: fully inert
+
+
+def test_flag_off_engine_has_no_ledger_and_identical_lowering(lm, make_engine):
+    """``DISTKERAS_ACCOUNTING=0`` must be *free*: no ledger object on the
+    engine, no accounting instruments on its registry, and the jitted
+    decode program lowers byte-identical to the accounting-on build."""
+    module, params = lm
+
+    def lowering(engine):
+        return engine._decode.lower(
+            engine._spec.params(), engine._cache.k_pages,
+            engine._cache.v_pages, jnp.asarray(engine._cache.tables),
+            jnp.asarray(engine._pos), jnp.asarray(engine._last),
+            jnp.asarray(engine._keys), jnp.asarray(engine._temp),
+            jnp.asarray(engine._topk), jnp.asarray(engine._topp),
+            jnp.asarray(engine._active),
+        ).as_text()
+
+    accounting.configure(False)
+    registry_off = Registry()
+    engine_off = make_engine(module, params, registry=registry_off)
+    assert engine_off._ledger is None
+    assert accounting.maybe_ledger(registry_off) is None
+    text_off = lowering(engine_off)
+    assert not any(name.startswith("accounting_")
+                   for name in registry_off.snapshot())
+
+    accounting.configure(True)
+    engine_on = make_engine(module, params, registry=Registry())
+    assert engine_on._ledger is not None
+    assert lowering(engine_on) == text_off  # byte-identical traced program
+
+
+def test_flag_env_resolution(monkeypatch):
+    accounting.configure(None)
+    monkeypatch.setenv("DISTKERAS_ACCOUNTING", "0")
+    assert not accounting.enabled()
+    accounting.configure(None)
+    monkeypatch.setenv("DISTKERAS_ACCOUNTING", "1")
+    assert accounting.enabled()
+    monkeypatch.delenv("DISTKERAS_ACCOUNTING")
+    accounting.configure(None)
+    assert accounting.enabled()  # unset defaults ON (telemetry is on)
+    telemetry.configure(False)
+    assert not accounting.enabled()  # telemetry master switch wins
+    telemetry.configure(True)
+    accounting.configure(True)
+
+
+def test_overhead_is_bounded(lm, make_engine):
+    """Accounting adds host-side dict work only; a generous pin guards
+    against accidentally dragging device syncs into the billing path."""
+    import time as _time
+    module, params = lm
+    prompt = list(range(1, 5))
+
+    def timed():
+        engine = make_engine(module, params, registry=Registry())
+        engine.generate(prompt, 4, tenant="acme", timeout=120.0)  # warm
+        t0 = _time.perf_counter()
+        for _ in range(3):
+            engine.generate(prompt, 4, tenant="acme", timeout=120.0)
+        return _time.perf_counter() - t0
+
+    accounting.configure(True)
+    on = timed()
+    accounting.configure(False)
+    off = timed()
+    # generous 3x pin: catches a device sync (orders of magnitude), not CI
+    # scheduling noise
+    assert on < max(3.0 * off, off + 1.0)
+
+
+# ----------------------------------------------------- /ledger endpoint
+
+
+def test_ledger_endpoint_live_scrape():
+    ledger = accounting.ledger_for()  # process-global registry
+    ledger.admit("acme", prompt_tokens=5, queue_wait_s=0.01, device_s=0.1)
+    server_mod.configure(0)
+    addr = server_mod.ensure_server()
+    assert addr is not None
+    with urllib.request.urlopen(f"http://{addr}/ledger", timeout=10) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("application/json")
+        payload = json.loads(r.read().decode("utf-8"))
+    assert payload["enabled"] is True
+    assert _rows(payload)["acme"]["prefill_tokens"] == 5
+
+    accounting.configure(False)
+    with urllib.request.urlopen(f"http://{addr}/ledger", timeout=10) as r:
+        off = json.loads(r.read().decode("utf-8"))
+    assert off == {"enabled": False, "tenants": []}
+    accounting.configure(True)
+
+
+def test_ledger_view_disabled_shape():
+    accounting.configure(False)
+    ctype, body, status = accounting.ledger_view()
+    assert status == 200 and ctype == "application/json"
+    assert json.loads(body) == {"enabled": False, "tenants": []}
+
+
+# ------------------------------------------------------------- dkmon top
+
+
+def test_dkmon_top_from_http_and_daemon_sources(capsys):
+    """``dkmon top`` must work against both transports: a process's
+    ``/ledger`` endpoint and the daemon's fleet-merged ``ledger_status``."""
+    from distkeras_tpu.job_deployment import Job, PunchcardServer
+    from tools.dkmon import render_top
+    from tools.dkmon.__main__ import main as dkmon_main
+
+    ledger = accounting.ledger_for()  # process-global: both sources see it
+    ledger.admit("acme", prompt_tokens=9, queue_wait_s=0.01, device_s=0.1)
+    ledger.admit("zen", prompt_tokens=2, queue_wait_s=0.02, device_s=0.05)
+    ledger.request("acme", attempts=2)
+
+    server_mod.configure(0)
+    addr = server_mod.ensure_server()
+    assert dkmon_main(["top", "--address", addr]) == 0
+    out = capsys.readouterr().out
+    assert "TENANT" in out and "acme" in out and "zen" in out
+    assert "1 eviction(s)" not in out
+    assert dkmon_main(["top", "--address", addr, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["enabled"] is True
+
+    daemon = PunchcardServer(port=0, secret="s3cret")
+    daemon.start()
+    try:
+        reply = Job("127.0.0.1", daemon.port, secret="s3cret").ledger_status()
+        assert reply["status"] == "ok" and reply["enabled"] is True
+        assert _rows(reply)["acme"]["prefill_tokens"] == 9
+        assert reply["jobs"] == 0  # no live jobs: the daemon's own process
+        assert dkmon_main(["top", "--daemon",
+                           f"127.0.0.1:{daemon.port}",
+                           "--secret", "s3cret"]) == 0
+        out = capsys.readouterr().out
+        assert "acme" in out and "0 live job(s)" in out
+    finally:
+        daemon.stop()
+
+    # a dead source is exit 3, matching status/check
+    assert dkmon_main(["top", "--address", "127.0.0.1:1"]) == 3
+    assert "error" in capsys.readouterr().err
+
+    # hottest tenant renders first (the ledger sorts by total tokens)
+    table = render_top(accounting.ledger_payload())
+    lines = table.splitlines()
+    assert lines[1].startswith("acme") and lines[2].startswith("zen")
+    assert render_top({"enabled": False, "tenants": []}).startswith(
+        "accounting disabled")

@@ -476,6 +476,41 @@ def test_dk117_out_of_package_is_silent():
     assert got == []
 
 
+def test_dk117_tenant_labels_fixture(tmp_path):
+    assert _run_in_package(
+        tmp_path, "dk117_tenant_labels.py", ["DK117"]
+    ) == [
+        ("DK117", 17),  # f-string metric name interpolating tenant
+        ("DK117", 20),  # % composition with a tenant_id variable
+        ("DK117", 22),  # labels= dict with a tenant key
+        ("DK117", 24),  # labels= dict value reading tenant_id
+        ("DK117", 26),  # labels= expression reading tenant
+    ]
+
+
+def test_dk117_tenant_sanctioned_homes_are_silent(tmp_path):
+    """Literal names, bounded deploy labels, span args, and the ledger API
+    (the sanctioned aggregation home for tenants) all stay unflagged."""
+    lines = [ln for _, ln in _run_in_package(
+        tmp_path, "dk117_tenant_labels.py", ["DK117"])]
+    assert all(ln < 34 for ln in lines), lines  # everything in clean() silent
+
+
+def test_dk117_accounting_module_is_tenant_exempt(tmp_path):
+    """The bounded top-K ledger module itself may carry tenant state — the
+    same source analyzed as distkeras_tpu.telemetry.accounting is clean."""
+    src = open(os.path.join(FIXTURES, "dk117_tenant_labels.py")).read()
+    pkg = tmp_path / "distkeras_tpu"
+    sub = pkg / "telemetry"
+    sub.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (sub / "__init__.py").write_text("")
+    (sub / "accounting.py").write_text(src)
+    findings, _ = analyze([str(sub / "accounting.py")], root=str(tmp_path),
+                          select=["DK117"])
+    assert findings == []
+
+
 def test_dk118_atomic_publish_fixture():
     got, _ = _run("dk118_checkpoint_pub.py", ["DK118"])
     assert got == [

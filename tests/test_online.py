@@ -74,6 +74,7 @@ def test_online_metrics_schema_golden():
     m["ingested"].inc(5)
     m["dropped"].inc(3)
     m["quota_drops"].inc(2)
+    m["rate_drops"].inc(4)
     m["capture_errors"].inc(1)
     m["windows_published"].inc(2)
     m["windows_trained"].inc(2)
@@ -116,6 +117,61 @@ def test_sampling_policy_validation():
         SamplingPolicy(rate=1.5)
     with pytest.raises(ValueError):
         SamplingPolicy(tenant_quota=0)
+    with pytest.raises(ValueError):
+        SamplingPolicy(tenant_rate=0.0)
+    with pytest.raises(ValueError):
+        SamplingPolicy(rate_unit="bogus")
+
+
+class _FixedRateLedger:
+    """Stand-in for the accounting ledger: fixed rolling rates by tenant."""
+
+    def __init__(self, rates, unit="tokens"):
+        self.rates, self.unit = rates, unit
+
+    def rolling_rate(self, tenant, unit="tokens"):
+        assert unit == self.unit
+        return self.rates.get(tenant, 0.0)
+
+
+def test_sampling_policy_tenant_rate_thins_hot_tenant():
+    ledger = _FixedRateLedger({"hot": 40.0, "warm": 10.0}, unit="tokens")
+    policy = SamplingPolicy(tenant_rate=10.0, rate_unit="tokens",
+                            ledger=ledger, seed=7)
+    # at or under the target: never thinned
+    assert all(policy.admit(s, "warm", 0, [1], [2]) is None
+               for s in range(200))
+    # unknown to the ledger (rate 0.0): no usage signal, no throttle
+    assert all(policy.admit(s, "cold", 0, [1], [2]) is None
+               for s in range(50))
+    # 4x over target: thinned to ~target/observed = 25% admitted
+    decisions = [policy.admit(s, "hot", 0, [1], [2]) for s in range(400)]
+    drops = decisions.count("rate")
+    assert 0 < 400 - drops < 400
+    assert abs((400 - drops) / 400 - 0.25) < 0.1
+    # stateless determinism: a fresh instance re-derives every decision
+    again = SamplingPolicy(tenant_rate=10.0, rate_unit="tokens",
+                           ledger=ledger, seed=7)
+    assert decisions == [again.admit(s, "hot", 0, [1], [2])
+                         for s in range(400)]
+    # the rate draw is decorrelated from the sampling draw: with rate=1.0
+    # the two gates can't shadow each other's subsets
+    mixed = SamplingPolicy(rate=0.5, tenant_rate=10.0, rate_unit="tokens",
+                           ledger=ledger, seed=7)
+    reasons = {mixed.admit(s, "hot", 0, [1], [2]) for s in range(200)}
+    assert reasons == {None, "sampled", "rate"}
+    # without a ledger the knob is inert
+    assert SamplingPolicy(tenant_rate=10.0).admit(0, "hot", 0, [1], [2]) \
+        is None
+
+
+def test_sampling_policy_rate_unit_requests():
+    ledger = _FixedRateLedger({"hot": 8.0}, unit="requests")
+    policy = SamplingPolicy(tenant_rate=2.0, rate_unit="samples",
+                            ledger=ledger, seed=3)
+    decisions = [policy.admit(s, "hot", 0, [1], [2]) for s in range(400)]
+    admitted = decisions.count(None)
+    assert abs(admitted / 400 - 0.25) < 0.1  # 2/8 of traffic admitted
 
 
 # -------------------------------------------------- capture + publication
@@ -168,6 +224,25 @@ def test_capture_tenant_quota_caps_hot_tenant(tmp_path):
     assert snap["online_quota_drops_total"]["value"] == drops
     assert snap["online_samples_dropped_total"]["value"] == drops
     assert log.dropped()["quota"] == drops
+    log.close()
+
+
+def test_capture_tenant_rate_policy_counts_rate_drops(tmp_path):
+    d = str(tmp_path / "cap")
+    registry = Registry()
+    ledger = _FixedRateLedger({"hot": 100.0}, unit="tokens")
+    log = TrafficLog(d, window_samples=4, max_len=8,
+                     policy=SamplingPolicy(tenant_rate=25.0,
+                                           rate_unit="tokens",
+                                           ledger=ledger, seed=9),
+                     registry=registry)
+    admitted = [log.record(*_gen(i, tenant="hot")) for i in range(40)]
+    drops = admitted.count(False)
+    assert 0 < drops < 40  # thinned toward 25%, not zeroed
+    snap = registry.snapshot()
+    assert snap["online_rate_drops_total"]["value"] == drops
+    assert snap["online_samples_dropped_total"]["value"] == drops
+    assert log.dropped()["rate"] == drops
     log.close()
 
 

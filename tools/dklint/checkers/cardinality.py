@@ -10,15 +10,24 @@ nothing.  Per-request IDs belong in **trace-span args** (where
 ``dktrace critical-path`` joins on them) and structured logs, never in
 metrics.
 
+Tenant identifiers (``tenant``, ``tenant_id``) are the same hazard in
+slower motion: the value space is one-per-client instead of
+one-per-request, but it is still externally controlled and unbounded — a
+misbehaving frontend can mint series at will.  Per-tenant attribution is
+owned by the bounded top-K ledger in
+:mod:`distkeras_tpu.telemetry.accounting` (overflow folds into
+``__other__``), which is therefore the one module exempt from the tenant
+rule.
+
 Flagged, package-scoped (``distkeras_tpu``):
 
 * a metric registration (``*.counter/gauge/histogram(...)``) whose *name*
-  argument is computed from an ID — f-string interpolation, ``%`` / ``+``
-  / ``.format()`` composition — e.g.
+  argument is computed from an ID or tenant — f-string interpolation,
+  ``%`` / ``+`` / ``.format()`` composition — e.g.
   ``registry.counter(f"requests_{req.request_id}")``;
-* a ``labels=`` dict whose **keys** include an ID name, or whose values
-  read an ID variable/attribute — e.g.
-  ``to_prometheus(labels={"request_id": rid})``.
+* a ``labels=`` dict whose **keys** include an ID/tenant name, or whose
+  values read an ID/tenant variable/attribute — e.g.
+  ``to_prometheus(labels={"tenant": req.tenant})``.
 
 Literal metric names can't embed a per-request value, so they are always
 clean here (DK114 owns literal-name hygiene); trace-span calls are not
@@ -28,7 +37,7 @@ metric calls and are untouched — they are the sanctioned home.
 from __future__ import annotations
 
 import ast
-from typing import Iterable, Optional
+from typing import FrozenSet, Iterable, Optional
 
 from tools.dklint.core import Checker, FileInfo, Finding, Project
 from tools.dklint.registry import register
@@ -38,9 +47,17 @@ METRIC_KINDS = frozenset({"counter", "gauge", "histogram"})
 #: identifiers whose value space is one-per-request/run — unbounded
 ID_NAMES = frozenset({"request_id", "trace_id", "job_id"})
 
+#: identifiers whose value space is one-per-client — externally controlled
+#: and unbounded; attribution belongs in the accounting ledger
+TENANT_NAMES = frozenset({"tenant", "tenant_id"})
 
-def _id_reference(node: ast.AST) -> Optional[str]:
-    """The per-request ID name this expression reads, if any —
+#: modules allowed to hold tenant state: the bounded top-K ledger itself
+TENANT_EXEMPT_MODULES = frozenset({"distkeras_tpu.telemetry.accounting"})
+
+
+def _id_reference(node: ast.AST,
+                  names: FrozenSet[str] = ID_NAMES) -> Optional[str]:
+    """The unbounded-identifier name this expression reads, if any —
     ``request_id``, ``req.request_id``, ``self._trace_id``, ... (an
     underscore-prefixed spelling still counts)."""
     for sub in ast.walk(node):
@@ -52,27 +69,38 @@ def _id_reference(node: ast.AST) -> Optional[str]:
         if name is None:
             continue
         bare = name.lstrip("_")
-        if bare in ID_NAMES:
+        if bare in names:
             return bare
     return None
 
 
-def _computed_name_id(arg: ast.AST) -> Optional[str]:
-    """ID referenced by a *computed* metric-name expression (literal
-    constants can't embed a per-request value)."""
+def _computed_name_id(arg: ast.AST,
+                      names: FrozenSet[str] = ID_NAMES) -> Optional[str]:
+    """Identifier referenced by a *computed* metric-name expression
+    (literal constants can't embed a per-request value)."""
     if isinstance(arg, ast.Constant):
         return None
     if isinstance(arg, ast.JoinedStr):
         for value in arg.values:
             if isinstance(value, ast.FormattedValue):
-                hit = _id_reference(value.value)
+                hit = _id_reference(value.value, names)
                 if hit:
                     return hit
         return None
     if isinstance(arg, (ast.BinOp, ast.Call)):
         # "requests_" + rid / "requests_%s" % rid / "...".format(rid)
-        return _id_reference(arg)
+        return _id_reference(arg, names)
     return None
+
+
+def _why(hit: str) -> str:
+    """Rule-appropriate remediation tail for the flagged identifier."""
+    if hit in TENANT_NAMES:
+        return ("one series per client, minted by the caller; per-tenant "
+                "attribution belongs in the bounded top-K accounting "
+                "ledger (telemetry.accounting), not metric labels")
+    return ("one immortal series per request; span args are the "
+            "sanctioned home for request ids")
 
 
 @register
@@ -80,32 +108,35 @@ class CardinalityChecker(Checker):
     rule = "DK117"
     name = "metric-label-cardinality"
     description = (
-        "per-request IDs (request_id/trace_id/job_id) used as a metric "
-        "label or metric-name component — one immortal series per request"
+        "per-request IDs (request_id/trace_id/job_id) or raw tenant "
+        "strings used as a metric label or metric-name component — "
+        "unbounded series cardinality"
     )
 
     def check(self, project: Project, fi: FileInfo) -> Iterable[Finding]:
         mod = fi.module or ""
         if mod != "distkeras_tpu" and not mod.startswith("distkeras_tpu."):
             return
+        names = ID_NAMES
+        if mod not in TENANT_EXEMPT_MODULES:
+            names = names | TENANT_NAMES
         for node in ast.walk(fi.tree):
             if not isinstance(node, ast.Call):
                 continue
-            yield from self._check_call(fi, node)
+            yield from self._check_call(fi, node, names)
 
-    def _check_call(self, fi: FileInfo, node: ast.Call) -> Iterable[Finding]:
-        # (1) computed metric *name* embedding an ID
+    def _check_call(self, fi: FileInfo, node: ast.Call,
+                    names: FrozenSet[str]) -> Iterable[Finding]:
+        # (1) computed metric *name* embedding an ID/tenant
         if isinstance(node.func, ast.Attribute) \
                 and node.func.attr in METRIC_KINDS and node.args:
-            hit = _computed_name_id(node.args[0])
+            hit = _computed_name_id(node.args[0], names)
             if hit:
                 yield self._finding(
                     fi, node.args[0],
-                    f"metric name is computed from per-request "
-                    f"'{hit}' — every request mints a new immortal time "
-                    "series; put the id in trace-span args instead",
+                    f"metric name is computed from '{hit}' — {_why(hit)}",
                 )
-        # (2) labels= carrying an ID as key or value
+        # (2) labels= carrying an ID/tenant as key or value
         for kw in node.keywords:
             if kw.arg != "labels":
                 continue
@@ -113,31 +144,27 @@ class CardinalityChecker(Checker):
                 for key, value in zip(kw.value.keys, kw.value.values):
                     if isinstance(key, ast.Constant) \
                             and isinstance(key.value, str) \
-                            and key.value.lstrip("_") in ID_NAMES:
+                            and key.value.lstrip("_") in names:
                         yield self._finding(
                             fi, key,
-                            f"metric label key '{key.value}' is a "
-                            "per-request id — unbounded label "
-                            "cardinality; span args are the sanctioned "
-                            "home for request ids",
+                            f"metric label key '{key.value}' — "
+                            f"{_why(key.value.lstrip('_'))}",
                         )
                         continue
-                    hit = _id_reference(value) if value is not None else None
+                    hit = _id_reference(value, names) \
+                        if value is not None else None
                     if hit:
                         yield self._finding(
                             fi, value,
-                            f"metric label value reads per-request "
-                            f"'{hit}' — unbounded label cardinality; "
-                            "span args are the sanctioned home",
+                            f"metric label value reads '{hit}' — "
+                            f"{_why(hit)}",
                         )
             else:
-                hit = _id_reference(kw.value)
+                hit = _id_reference(kw.value, names)
                 if hit:
                     yield self._finding(
                         fi, kw.value,
-                        f"labels= expression reads per-request '{hit}' — "
-                        "unbounded label cardinality; span args are the "
-                        "sanctioned home",
+                        f"labels= expression reads '{hit}' — {_why(hit)}",
                     )
 
     def _finding(self, fi: FileInfo, node: ast.AST, why: str) -> Finding:

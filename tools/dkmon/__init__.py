@@ -14,6 +14,11 @@ Three ways to reach the signal plane, one normalized shape out:
 Everything returns/consumes ``{"engines": {name: status}, "incidents":
 [...]}`` where ``status`` is :meth:`SLOEngine.status`'s dict — the CLI in
 ``__main__`` only renders and gates.
+
+``dkmon top`` rides the same transports for the *accounting* plane: a
+process's ``/ledger`` endpoint (``--address``) or the daemon's
+``ledger_status`` verb (``--daemon``, fleet-merged tenant-wise) — one
+per-tenant usage table out, rendered hottest-first.
 """
 
 from __future__ import annotations
@@ -24,10 +29,13 @@ from typing import Dict, List, Optional
 __all__ = [
     "fetch_address",
     "fetch_daemon",
+    "fetch_ledger_address",
+    "fetch_ledger_daemon",
     "firing_rows",
     "firing_from_incidents",
     "load_incidents",
     "render_status",
+    "render_top",
 ]
 
 
@@ -55,6 +63,31 @@ def fetch_daemon(host: str, port: int, secret: str = "",
     return {"engines": dict(reply.get("engines") or {}),
             "firing": list(reply.get("firing") or ()),
             "timeseries": reply.get("timeseries")}
+
+
+def fetch_ledger_address(address: str, timeout: float = 3.0) -> dict:
+    """Scrape ``/ledger`` from a flightdeck exporter at ``host:port`` —
+    one process's per-tenant accounting table."""
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{address}/ledger",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def fetch_ledger_daemon(host: str, port: int, secret: str = "",
+                        timeout: float = 10.0) -> dict:
+    """Fetch the fleet-merged accounting table through the daemon's
+    ``ledger_status`` verb (every live job's ``/ledger`` plus the daemon's
+    own process, tenant-wise merged)."""
+    from distkeras_tpu.job_deployment import Job
+
+    job = Job(host, port, secret=secret, rpc_timeout=timeout)
+    reply = job.ledger_status()
+    if reply.get("status") != "ok":
+        raise ValueError(f"daemon refused ledger_status: {reply}")
+    reply.pop("status", None)
+    return reply
 
 
 def load_incidents(path: str) -> List[dict]:
@@ -130,4 +163,37 @@ def render_status(engines: Dict[str, dict],
     lines.append(f"{total} objective(s), {firing} firing")
     if incidents:
         lines.append(f"{len(incidents)} incident record(s) in log")
+    return "\n".join(lines)
+
+
+def render_top(payload: dict) -> str:
+    """The ``dkmon top`` table: one row per tenant, hottest first (the
+    ledger already sorts by total tokens descending)."""
+    if not payload.get("enabled", True):
+        return "accounting disabled (DISTKERAS_ACCOUNTING=0 or telemetry off)"
+    lines = [
+        f"{'TENANT':<20}{'TOK/S':>9}{'TOKENS':>10}{'REQS':>7}{'FAILOVER':>9}"
+        f"{'PAGE-S':>10}{'QUEUE p99':>11}{'SHARE':>8}"
+    ]
+    for row in payload.get("tenants") or ():
+        tokens = (int(row.get("prefill_tokens") or 0)
+                  + int(row.get("decode_tokens") or 0))
+        lines.append(
+            f"{row['tenant']:<20}"
+            f"{float(row.get('tokens_per_s') or 0.0):>9.2f}"
+            f"{tokens:>10d}"
+            f"{int(row.get('requests') or 0):>7d}"
+            f"{int(row.get('failover_attempts') or 0):>9d}"
+            f"{float(row.get('page_seconds') or 0.0):>10.2f}"
+            f"{float(row.get('queue_p99_s') or 0.0):>10.3f}s"
+            f"{100.0 * float(row.get('share') or 0.0):>7.1f}%"
+        )
+    totals = payload.get("totals") or {}
+    tail = (f"{len(payload.get('tenants') or ())} tenant(s), "
+            f"{int(totals.get('tokens') or 0)} tokens, "
+            f"{int(totals.get('requests') or 0)} request(s), "
+            f"{int(payload.get('evictions') or 0)} eviction(s)")
+    if payload.get("jobs") is not None:
+        tail += f", {int(payload['jobs'])} live job(s)"
+    lines.append(tail)
     return "\n".join(lines)

@@ -1,6 +1,8 @@
-"""CLI: ``python -m tools.dkmon {status|watch|check}`` against a live
+"""CLI: ``python -m tools.dkmon {status|watch|check|top}`` against a live
 flightdeck exporter (``--address``), a daemon (``--daemon``), or an
-incident JSONL log (``--incidents``).
+incident JSONL log (``--incidents``).  ``top`` is the accounting view:
+per-tenant tokens/sec, page-seconds, queue p99, and share-of-fleet from a
+process's ``/ledger`` or the daemon's fleet-merged ``ledger_status``.
 
 ``check`` is the automation gate: exit 0 when nothing is firing, 2 when
 any alert fires, 3 on a source error — the same contract as
@@ -17,10 +19,13 @@ import time
 from tools.dkmon import (
     fetch_address,
     fetch_daemon,
+    fetch_ledger_address,
+    fetch_ledger_daemon,
     firing_from_incidents,
     firing_rows,
     load_incidents,
     render_status,
+    render_top,
 )
 
 
@@ -69,7 +74,35 @@ def main(argv=None) -> int:
     check = sub.add_parser(
         "check", help="exit 0 clean, 2 on any firing alert (the CI gate)")
     _add_source_args(check)
+    top = sub.add_parser(
+        "top", help="per-tenant accounting table (ledger), hottest first")
+    src = top.add_mutually_exclusive_group(required=True)
+    src.add_argument("--address", metavar="HOST:PORT",
+                     help="a flightdeck exporter's /ledger endpoint")
+    src.add_argument("--daemon", metavar="HOST:PORT",
+                     help="a PunchcardServer (fleet-merged ledger_status)")
+    top.add_argument("--secret", default="",
+                     help="daemon shared secret (with --daemon)")
+    top.add_argument("--json", action="store_true", dest="as_json",
+                     help="emit the raw ledger payload as JSON")
     args = parser.parse_args(argv)
+
+    if args.cmd == "top":
+        try:
+            if args.address:
+                payload = fetch_ledger_address(args.address)
+            else:
+                host, _, port = args.daemon.rpartition(":")
+                payload = fetch_ledger_daemon(host or "127.0.0.1", int(port),
+                                              secret=args.secret)
+        except (OSError, ValueError) as e:
+            print(f"dkmon: error: {e}", file=sys.stderr)
+            return 3
+        if args.as_json:
+            print(json.dumps(payload, indent=1))
+        else:
+            print(render_top(payload))
+        return 0
 
     if args.cmd == "watch":
         n = 0
